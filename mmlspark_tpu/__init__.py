@@ -1,0 +1,28 @@
+"""mmlspark_tpu — a TPU-native distributed-ML framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of SynapseML
+(memoryz/mmlspark): distributed gradient-boosted trees, online linear
+learners, ONNX-graph inference, featurization, model interpretability,
+AutoML, recommenders and serving — built SPMD-first on `jax.sharding.Mesh`
+instead of Spark driver/executor topology.
+
+Architecture (vs. reference layer map, SURVEY.md §1):
+  - Spark DataFrame        -> `mmlspark_tpu.core.dataframe.DataFrame` (columnar,
+                              numpy host side / jnp device side)
+  - Spark ML Params        -> `mmlspark_tpu.core.param`
+  - Estimator/Transformer  -> `mmlspark_tpu.core.pipeline`
+  - mapPartitions + JNI    -> jit/shard_map-compiled JAX kernels
+  - NetworkManager sockets -> `jax.lax.psum` & friends over ICI/DCN
+                              (`mmlspark_tpu.parallel`)
+"""
+
+__version__ = "0.1.0"
+
+from mmlspark_tpu.core.dataframe import DataFrame  # noqa: F401
+from mmlspark_tpu.core.pipeline import (  # noqa: F401
+    Estimator,
+    Model,
+    Pipeline,
+    PipelineModel,
+    Transformer,
+)

@@ -1,0 +1,107 @@
+"""Structured telemetry on every fit/transform.
+
+Analog of the reference's ``SynapseMLLogging`` (core/.../logging/
+SynapseMLLogging.scala:49-172): wrap each stage's constructor/fit/transform
+in a JSON log record carrying uid, class, method, wall-clock seconds and
+error info, with secret scrubbing (logging/common/Scrubber.scala:1).
+Instead of posting to MS-Fabric "certified events"
+(CertifiedEventClient.scala:16-21) records go to a process-local sink the
+host application can drain or redirect.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import time
+import traceback
+import uuid
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger("mmlspark_tpu")
+
+_SECRET_PATTERNS = [
+    re.compile(r"(sig|key|token|password|secret|authorization)=[^&\s\"]+", re.I),
+    re.compile(r"Bearer\s+[A-Za-z0-9._\-]+"),
+    re.compile(r"sk-[A-Za-z0-9\-_]{10,}"),
+]
+
+
+def scrub(text: str) -> str:
+    """Remove credential-looking substrings (Scrubber.scala analog)."""
+    for pat in _SECRET_PATTERNS:
+        text = pat.sub(lambda m: m.group(0).split("=")[0] + "=[REDACTED]"
+                       if "=" in m.group(0) else "[REDACTED]", text)
+    return text
+
+
+class TelemetrySink:
+    """In-process event buffer; swap `emit` to forward elsewhere."""
+
+    def __init__(self, capacity: int = 10_000):
+        self.capacity = capacity
+        self.events: List[Dict[str, Any]] = []
+        self.enabled = True
+
+    def emit(self, event: Dict[str, Any]) -> None:
+        if not self.enabled:
+            return
+        if len(self.events) >= self.capacity:
+            del self.events[: self.capacity // 2]
+        self.events.append(event)
+        logger.debug("telemetry %s", json.dumps(event, default=str))
+
+    def drain(self) -> List[Dict[str, Any]]:
+        out, self.events = self.events, []
+        return out
+
+
+SINK = TelemetrySink()
+
+
+def new_uid(prefix: str) -> str:
+    return f"{prefix}_{uuid.uuid4().hex[:12]}"
+
+
+@contextmanager
+def log_stage_method(uid: str, class_name: str, method: str,
+                     extra: Optional[Dict[str, Any]] = None):
+    t0 = time.perf_counter()
+    record: Dict[str, Any] = {
+        "uid": uid,
+        "className": class_name,
+        "method": method,
+        **(extra or {}),
+    }
+    try:
+        yield record
+    except Exception as e:  # noqa: BLE001 — telemetry must not swallow
+        record["error"] = scrub(f"{type(e).__name__}: {e}")
+        record["traceback"] = scrub(traceback.format_exc(limit=5))
+        record["seconds"] = time.perf_counter() - t0
+        SINK.emit(record)
+        raise
+    record["seconds"] = time.perf_counter() - t0
+    SINK.emit(record)
+
+
+def log_fit(fn: Callable) -> Callable:
+    def wrapper(self, dataset, *args, **kwargs):
+        with log_stage_method(self.uid, type(self).__name__, "fit",
+                              {"numRows": getattr(dataset, "num_rows", None)}):
+            return fn(self, dataset, *args, **kwargs)
+
+    wrapper.__name__ = fn.__name__
+    return wrapper
+
+
+def log_transform(fn: Callable) -> Callable:
+    def wrapper(self, dataset, *args, **kwargs):
+        with log_stage_method(self.uid, type(self).__name__, "transform",
+                              {"numRows": getattr(dataset, "num_rows", None)}):
+            return fn(self, dataset, *args, **kwargs)
+
+    wrapper.__name__ = fn.__name__
+    return wrapper

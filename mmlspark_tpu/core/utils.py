@@ -1,0 +1,88 @@
+"""Fault-tolerance and environment-probing utilities.
+
+Analogs of the reference's core/utils: ``FaultToleranceUtils.retryWithTimeout``
+(core/utils/FaultToleranceUtils.scala:9-31), the exponential-backoff retry
+around network init (lightgbm/.../NetworkManager.scala:195-218), and
+``ClusterUtil`` topology probing (core/utils/ClusterUtil.scala:22-47) —
+here the "cluster" is the JAX device/process topology.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Tuple
+
+
+class RetriesExceededError(RuntimeError):
+    pass
+
+
+def retry_with_backoff(fn: Callable[[], Any], retries: int = 5,
+                       initial_delay: float = 0.1, backoff: float = 2.0,
+                       exceptions: Tuple[type, ...] = (Exception,),
+                       on_retry: Optional[Callable[[int, Exception], None]] = None) -> Any:
+    delay = initial_delay
+    last: Optional[Exception] = None
+    for attempt in range(retries):
+        try:
+            return fn()
+        except exceptions as e:  # noqa: PERF203
+            last = e
+            if on_retry is not None:
+                on_retry(attempt, e)
+            if attempt < retries - 1:
+                time.sleep(delay)
+                delay *= backoff
+    raise RetriesExceededError(f"failed after {retries} attempts") from last
+
+
+def retry_with_timeout(fn: Callable[[], Any], timeout_seconds: float,
+                       retries: int = 3) -> Any:
+    """Per-attempt deadline + retry (FaultToleranceUtils.scala:9-31 analog).
+
+    Python cannot preempt an arbitrary call, so the deadline is enforced
+    post-hoc: an attempt that overruns raises and may be retried.
+    """
+    last: Optional[Exception] = None
+    for _ in range(retries):
+        t0 = time.perf_counter()
+        try:
+            result = fn()
+        except Exception as e:  # noqa: BLE001
+            last = e
+            continue
+        if time.perf_counter() - t0 <= timeout_seconds:
+            return result
+        last = TimeoutError(f"attempt exceeded {timeout_seconds}s")
+    raise RetriesExceededError(f"failed after {retries} attempts") from last
+
+
+@dataclass
+class DeviceTopology:
+    """What ClusterUtil probed from Spark, probed from JAX instead."""
+
+    num_devices: int
+    num_local_devices: int
+    num_processes: int
+    process_index: int
+    platform: str
+
+    @staticmethod
+    def probe() -> "DeviceTopology":
+        import jax
+        return DeviceTopology(
+            num_devices=jax.device_count(),
+            num_local_devices=jax.local_device_count(),
+            num_processes=jax.process_count(),
+            process_index=jax.process_index(),
+            platform=jax.devices()[0].platform,
+        )
+
+
+def rows_per_shard(num_rows: int, num_shards: int) -> list:
+    """Deterministic near-equal row split (getNumRowsPerPartition analog,
+    core/utils/ClusterUtil.scala:47)."""
+    base = num_rows // num_shards
+    rem = num_rows % num_shards
+    return [base + (1 if i < rem else 0) for i in range(num_shards)]

@@ -1,0 +1,8 @@
+from mmlspark_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    create_mesh,
+    data_axis,
+    default_mesh,
+    feature_axis,
+    model_axis,
+)

@@ -1,0 +1,112 @@
+"""Device-mesh conventions — the communication backbone.
+
+Replaces all three coordination planes of the reference (SURVEY.md §2.9):
+the LightGBM driver TCP rendezvous + native ring (NetworkManager.scala),
+the VW spanning-tree allreduce (VowpalWabbitClusterUtil.scala:15-43), and
+Spark broadcast/collect/barrier — with a single `jax.sharding.Mesh` whose
+axes carry XLA collectives over ICI (intra-slice) and DCN (inter-slice).
+
+Axis conventions (used framework-wide):
+  - ``dp``  — data parallel: rows sharded; histogram/gradient `psum`
+              (LightGBM ``data_parallel``, VW allreduce, Horovod DP).
+  - ``fp``  — feature parallel: feature dimension of histogram build
+              sharded (LightGBM ``feature_parallel``).
+  - ``mp``  — model parallel: reserved for tensor-parallel DNN paths.
+
+The deterministic ring ordering the reference computes by sorting hosts on
+min partition id (NetworkManager.scala:322-328) is inherent here: mesh
+device order is deterministic, so no rendezvous is needed. The per-executor
+"main worker election" (SharedState.scala:55-63) maps to
+``process_index == 0`` / leader-by-mesh-coordinate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+DATA_AXIS = "dp"
+FEATURE_AXIS = "fp"
+MODEL_AXIS = "mp"
+
+
+def data_axis() -> str:
+    return DATA_AXIS
+
+
+def feature_axis() -> str:
+    return FEATURE_AXIS
+
+
+def model_axis() -> str:
+    return MODEL_AXIS
+
+
+@dataclass
+class MeshConfig:
+    """Declarative mesh shape; -1 means "all remaining devices"."""
+
+    dp: int = -1
+    fp: int = 1
+    mp: int = 1
+
+    def resolve(self, num_devices: int) -> Tuple[int, int, int]:
+        dp, fp, mp = self.dp, self.fp, self.mp
+        fixed = max(fp, 1) * max(mp, 1)
+        if dp == -1:
+            if num_devices % fixed:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fp*mp={fixed}")
+            dp = num_devices // fixed
+        if dp * fp * mp != num_devices:
+            raise ValueError(
+                f"mesh {dp}x{fp}x{mp} != {num_devices} devices")
+        return dp, fp, mp
+
+
+def create_mesh(config: Optional[MeshConfig] = None,
+                devices: Optional[Sequence] = None,
+                axis_names: Optional[Sequence[str]] = None):
+    """Build a Mesh over all (or given) devices.
+
+    Axes of size 1 are kept — collectives over singleton axes are no-ops,
+    which lets the same shard_mapped program run from 1 chip to a pod.
+    """
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    config = config or MeshConfig()
+    dp, fp, mp = config.resolve(len(devices))
+    names = tuple(axis_names) if axis_names else (DATA_AXIS, FEATURE_AXIS, MODEL_AXIS)
+    dev_array = np.array(devices).reshape(dp, fp, mp)
+    return jax.sharding.Mesh(dev_array, names)
+
+
+_DEFAULT_MESH = None
+
+
+def default_mesh():
+    """Process-wide data-parallel mesh over all devices (cached)."""
+    global _DEFAULT_MESH
+    import jax
+    if _DEFAULT_MESH is None or _DEFAULT_MESH.devices.size != len(jax.devices()):
+        _DEFAULT_MESH = create_mesh()
+    return _DEFAULT_MESH
+
+
+def axis_size(mesh, axis: str) -> int:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+
+
+def replicated(mesh):
+    import jax
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+
+def row_sharded(mesh, ndim: int = 1, axis: str = DATA_AXIS):
+    import jax
+    spec = [None] * ndim
+    spec[0] = axis
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(*spec))

@@ -1,0 +1,36 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+The reference tests multi-node behavior on a single JVM via ``local[*]``
+(SURVEY.md §4.4); the analog here is an 8-device CPU mesh via
+``xla_force_host_platform_device_count`` so shard_map/psum paths execute
+for real without TPU hardware. Must run before jax initializes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image's sitecustomize imports jax (axon TPU plugin) before conftest
+# runs, so the env vars above may be read too late — force via config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from mmlspark_tpu.parallel.mesh import create_mesh
+    return create_mesh()
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)

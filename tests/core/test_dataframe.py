@@ -1,0 +1,94 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+
+
+def make_df():
+    return DataFrame({
+        "x": np.arange(10, dtype=np.float32),
+        "v": np.arange(20, dtype=np.float64).reshape(10, 2),
+        "s": [f"row{i}" for i in range(10)],
+    })
+
+
+def test_schema_and_access():
+    df = make_df()
+    assert df.num_rows == 10
+    assert set(df.columns) == {"x", "v", "s"}
+    assert df.schema()["v"].startswith("vector[2")
+    assert df["s"][3] == "row3"
+
+
+def test_mismatched_lengths_raise():
+    with pytest.raises(ValueError):
+        DataFrame({"a": [1, 2], "b": [1, 2, 3]})
+
+
+def test_with_column_select_drop_rename():
+    df = make_df()
+    df2 = df.with_column("y", df["x"] * 2)
+    assert np.allclose(df2["y"], df["x"] * 2)
+    assert "y" not in df.columns  # original untouched
+    assert df2.select("x", "y").columns == ["x", "y"]
+    assert "x" not in df2.drop("x").columns
+    assert "z" in df2.rename({"y": "z"}).columns
+
+
+def test_filter_sort_sample():
+    df = make_df()
+    f = df.filter(df["x"] > 4)
+    assert f.num_rows == 5 and f["s"][0] == "row5"
+    srt = df.sort("x", ascending=False)
+    assert srt["x"][0] == 9
+    assert 0 < df.sample(0.5, seed=1).num_rows < 10
+
+
+def test_random_split_partitions_all_rows():
+    df = make_df()
+    parts = df.random_split([0.5, 0.3, 0.2], seed=7)
+    assert sum(p.num_rows for p in parts) == 10
+    all_s = sorted(s for p in parts for s in p["s"])
+    assert all_s == sorted(df["s"])
+
+
+def test_concat_and_group_indices():
+    df = make_df()
+    both = DataFrame.concat([df, df])
+    assert both.num_rows == 20
+    g = DataFrame({"k": [1, 2, 1, 2, 1], "v": [1., 2., 3., 4., 5.]})
+    groups = g.group_indices("k")
+    assert np.allclose(g["v"][groups[1]], [1., 3., 5.])
+
+
+def test_pandas_roundtrip():
+    df = make_df()
+    back = DataFrame.from_pandas(df.to_pandas())
+    assert back.num_rows == 10
+    assert np.allclose(back["v"], df["v"])
+
+
+def test_metadata():
+    df = make_df().with_metadata("s", {"categorical": True})
+    assert df.metadata("s")["categorical"] is True
+    assert df.metadata("x") == {}
+
+
+def test_to_device_sharded(mesh8):
+    df = DataFrame({"x": np.arange(13, dtype=np.float32)})
+    arrs, n = df.to_device(["x"], mesh=mesh8)
+    assert n == 13
+    assert arrs["x"].shape[0] % 8 == 0
+    assert float(arrs["x"][:13].sum()) == sum(range(13))
+
+
+def test_concat_empty_list_and_filter_list_mask():
+    assert DataFrame.concat([]).num_rows == 0
+    df = DataFrame({"x": np.arange(3.0)})
+    assert df.filter(lambda d: [True, False, True]).num_rows == 2
+
+
+def test_with_column_replacement_drops_stale_metadata():
+    df = DataFrame({"a": np.arange(3.0)}).with_metadata("a", {"levels": ["x"]})
+    replaced = df.with_column("a", np.zeros(3))
+    assert replaced.metadata("a") == {}

@@ -1,0 +1,81 @@
+import pytest
+
+from mmlspark_tpu.core.param import (
+    HasInputCol,
+    Param,
+    ParamValidationError,
+    Params,
+    ge,
+    in_range,
+    one_of,
+    to_float,
+    to_int,
+    to_list,
+    to_str,
+)
+
+
+class Demo(HasInputCol):
+    alpha = Param("alpha", "learning rate", to_float, in_range(0, 1), default=0.1)
+    iters = Param("iters", "iterations", to_int, ge(1), default=10)
+    mode = Param("mode", "mode", to_str, one_of("a", "b"), default="a")
+    names = Param("names", "names", to_list(to_str), default=None)
+
+
+def test_defaults_and_set():
+    d = Demo()
+    assert d.get("alpha") == 0.1
+    assert d.get("iters") == 10
+    d2 = Demo(alpha=0.5, iters=3, names=["x", "y"])
+    assert d2.get("alpha") == 0.5
+    assert d2.get("names") == ["x", "y"]
+    assert not d.is_set("alpha") and d2.is_set("alpha")
+
+
+def test_validation_errors():
+    with pytest.raises(ParamValidationError):
+        Demo(alpha=2.0)
+    with pytest.raises(ParamValidationError):
+        Demo(iters=0)
+    with pytest.raises(ParamValidationError):
+        Demo(mode="c")
+    with pytest.raises(ParamValidationError):
+        Demo(alpha="x")
+
+
+def test_int_converter_rejects_bool():
+    with pytest.raises(ParamValidationError):
+        Demo(iters=True)
+
+
+def test_inherited_params_and_copy():
+    d = Demo(inputCol="feat")
+    assert d.get("inputCol") == "feat"
+    c = d.copy(alpha=0.9)
+    assert c.get("alpha") == 0.9 and d.get("alpha") == 0.1
+    assert c.get("inputCol") == "feat"
+
+
+def test_unknown_param_raises():
+    with pytest.raises(KeyError):
+        Demo(bogus=1)
+
+
+def test_explain_params_mentions_all():
+    text = Demo().explain_params()
+    for name in ("alpha", "iters", "mode", "inputCol"):
+        assert name in text
+
+
+def test_numpy_scalars_accepted():
+    import numpy as np
+    d = Demo(alpha=np.float32(0.5), iters=np.int64(3))
+    assert d.get("alpha") == 0.5 and d.get("iters") == 3
+
+
+def test_set_none_clears_and_validates_name():
+    d = Demo(alpha=0.7)
+    d.set("alpha", None)
+    assert d.get("alpha") == 0.1 and not d.is_set("alpha")
+    with pytest.raises(KeyError):
+        d.set("weigthCol", None)
