@@ -1,0 +1,56 @@
+"""Benchmark: HIGGS-style LightGBM binary classification fit throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline anchor (BASELINE.md): the reference claims LightGBM-on-Spark is
+10-30% faster than SparkML GBT on HIGGS with no absolute numbers, so the
+recorded number is absolute training throughput (million rows * trees /
+second) on a HIGGS-shaped synthetic dataset (28 features, binary label).
+``vs_baseline`` compares against a conservative reference-GPU-executor
+anchor of 2.0 Mrow-trees/s.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
+    from mmlspark_tpu.ops.binning import BinMapper
+
+    rng = np.random.default_rng(0)
+    n, f = 400_000, 28  # HIGGS-shaped
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    logit = (x[:, 0] * 1.2 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
+             + 0.3 * np.sin(x[:, 4] * 3))
+    y = (logit + rng.normal(size=n) * 0.5 > 0).astype(np.float64)
+
+    mapper = BinMapper.fit(x[:100_000], max_bin=255)
+    binned = mapper.transform(x)
+    num_trees = 20
+    cfg = TrainConfig(objective="binary", num_iterations=num_trees,
+                      num_leaves=63, max_depth=6, min_data_in_leaf=20)
+
+    # warmup/compile
+    wcfg = TrainConfig(objective="binary", num_iterations=2, num_leaves=63,
+                       max_depth=6, min_data_in_leaf=20)
+    train(binned, y, wcfg, bin_upper=mapper.bin_upper_values(cfg.max_bin))
+
+    t0 = time.perf_counter()
+    result = train(binned, y, cfg, bin_upper=mapper.bin_upper_values(cfg.max_bin))
+    dt = time.perf_counter() - t0
+
+    row_trees_per_s = n * result.booster.num_trees / dt / 1e6
+    baseline = 2.0
+    print(json.dumps({
+        "metric": "gbdt_fit_throughput_higgs28f",
+        "value": round(row_trees_per_s, 3),
+        "unit": "Mrow-trees/s",
+        "vs_baseline": round(row_trees_per_s / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -206,6 +206,8 @@ class DataFrame:
     # -- groupby-lite (host side; used by SAR / ranking eval) ---------------
     def group_indices(self, by: str) -> Dict[Any, np.ndarray]:
         keys = self.col(by)
+        if len(keys) == 0:
+            return {}
         order = np.argsort(keys, kind="stable")
         sorted_keys = keys[order]
         bounds = np.nonzero(np.concatenate([[True], sorted_keys[1:] != sorted_keys[:-1]]))[0]

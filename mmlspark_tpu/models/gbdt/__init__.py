@@ -1,0 +1,10 @@
+from mmlspark_tpu.models.gbdt.booster import BoosterArrays  # noqa: F401
+from mmlspark_tpu.models.gbdt.estimators import (  # noqa: F401
+    LightGBMClassificationModel,
+    LightGBMClassifier,
+    LightGBMRanker,
+    LightGBMRankerModel,
+    LightGBMRegressionModel,
+    LightGBMRegressor,
+)
+from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train  # noqa: F401

@@ -1,0 +1,500 @@
+"""LightGBM-parity estimators: classifier / regressor / ranker.
+
+API parity targets (param names match the reference's Python surface):
+  - LightGBMClassifier / LightGBMClassificationModel
+    (lightgbm/.../LightGBMClassifier.scala:32,100)
+  - LightGBMRegressor (LightGBMRegressor.scala:1) — objectives incl.
+    quantile/tweedie/poisson per params/LightGBMParams.scala
+  - LightGBMRanker lambdarank (LightGBMRanker.scala:1)
+  - model methods: featureImportances, per-row leaf indices & feature
+    contributions (LightGBMModelMethods.scala:13), saveNativeModel /
+    loadNativeModelFromFile/-String (LightGBMClassifier.scala:196)
+  - warm start via modelString across batches (LightGBMBase.scala:45-60)
+
+Orchestration differences from the reference are deliberate: no
+driver/executor rendezvous, no coalesce-to-tasks — `fit` bins on host
+(reference-dataset analog), ships binned rows to the mesh, and the
+trainer's histogram reduction is XLA's all-reduce (data_parallel).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (
+    HasFeaturesCol,
+    HasLabelCol,
+    HasPredictionCol,
+    HasWeightCol,
+    Param,
+    ge,
+    gt,
+    in_range,
+    one_of,
+    to_bool,
+    to_float,
+    to_int,
+    to_list,
+    to_str,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.core.timer import InstrumentationMeasures
+from mmlspark_tpu.models.gbdt.booster import BoosterArrays
+from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
+from mmlspark_tpu.ops.binning import BinMapper
+
+
+class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
+    """Shared param block (params/LightGBMParams.scala:1 surface)."""
+
+    numIterations = Param("numIterations", "number of boosting iterations",
+                          to_int, ge(1), default=100)
+    learningRate = Param("learningRate", "shrinkage rate", to_float, gt(0),
+                         default=0.1)
+    numLeaves = Param("numLeaves", "max leaves per tree", to_int, ge(2),
+                      default=31)
+    maxDepth = Param("maxDepth", "max tree depth (<=0 means from numLeaves)",
+                     to_int, default=-1)
+    maxBin = Param("maxBin", "max feature bins", to_int, ge(4), default=255)
+    lambdaL1 = Param("lambdaL1", "L1 regularization", to_float, ge(0), default=0.0)
+    lambdaL2 = Param("lambdaL2", "L2 regularization", to_float, ge(0), default=0.0)
+    minDataInLeaf = Param("minDataInLeaf", "min rows per leaf", to_int, ge(0),
+                          default=20)
+    minSumHessianInLeaf = Param("minSumHessianInLeaf", "min hessian per leaf",
+                                to_float, ge(0), default=1e-3)
+    minGainToSplit = Param("minGainToSplit", "min split gain", to_float, ge(0),
+                           default=0.0)
+    featureFraction = Param("featureFraction", "feature subsample per tree",
+                            to_float, in_range(0, 1, lo_inclusive=False), default=1.0)
+    baggingFraction = Param("baggingFraction", "row subsample", to_float,
+                            in_range(0, 1, lo_inclusive=False), default=1.0)
+    baggingFreq = Param("baggingFreq", "re-bag every k iterations", to_int,
+                        ge(0), default=0)
+    baggingSeed = Param("baggingSeed", "bagging seed", to_int, default=3)
+    boostingType = Param("boostingType", "gbdt | rf | dart | goss", to_str,
+                         one_of("gbdt", "rf", "dart", "goss"), default="gbdt")
+    topRate = Param("topRate", "GOSS large-gradient keep rate", to_float,
+                    in_range(0, 1), default=0.2)
+    otherRate = Param("otherRate", "GOSS small-gradient sample rate", to_float,
+                      in_range(0, 1), default=0.1)
+    dropRate = Param("dropRate", "DART tree drop rate", to_float, in_range(0, 1),
+                     default=0.1)
+    skipDrop = Param("skipDrop", "DART skip-drop prob", to_float, in_range(0, 1),
+                     default=0.5)
+    earlyStoppingRound = Param("earlyStoppingRound",
+                               "stop after n rounds w/o improvement (0=off)",
+                               to_int, ge(0), default=0)
+    validationIndicatorCol = Param("validationIndicatorCol",
+                                   "bool column marking validation rows", to_str)
+    categoricalSlotIndexes = Param("categoricalSlotIndexes",
+                                   "indices of categorical features",
+                                   to_list(to_int))
+    objective = Param("objective", "training objective", to_str)
+    metric = Param("metric", "eval metric (default per objective)", to_str)
+    modelString = Param("modelString", "warm-start model string", to_str)
+    parallelism = Param("parallelism", "data_parallel | voting_parallel | "
+                        "feature_parallel | serial", to_str,
+                        one_of("data_parallel", "voting_parallel",
+                               "feature_parallel", "serial"),
+                        default="data_parallel")
+    useBarrierExecutionMode = Param("useBarrierExecutionMode",
+                                    "gang scheduling (TPU meshes are natively "
+                                    "gang-scheduled; accepted for parity)",
+                                    to_bool, default=False)
+    numBatches = Param("numBatches", "split training into n sequential "
+                       "batches, warm-starting each (LightGBMBase.scala:45-60)",
+                       to_int, ge(0), default=0)
+    seed = Param("seed", "random seed", to_int, default=0)
+    verbosity = Param("verbosity", "verbosity", to_int, default=-1)
+    leafPredictionCol = Param("leafPredictionCol",
+                              "output col for per-tree leaf indices", to_str)
+    featuresShapCol = Param("featuresShapCol",
+                            "output col for per-feature contributions", to_str)
+    predictDisableShapeCheck = Param("predictDisableShapeCheck",
+                                     "skip feature-count check at predict",
+                                     to_bool, default=False)
+
+    def _train_config(self, objective: str, num_class: int = 1,
+                      sigmoid: float = 1.0, **extra: Any) -> TrainConfig:
+        return TrainConfig(
+            objective=objective,
+            num_iterations=self.get("numIterations"),
+            learning_rate=self.get("learningRate"),
+            num_leaves=self.get("numLeaves"),
+            max_depth=self.get("maxDepth") if self.get("maxDepth") and self.get("maxDepth") > 0 else 16,
+            max_bin=self.get("maxBin"),
+            lambda_l1=self.get("lambdaL1"),
+            lambda_l2=self.get("lambdaL2"),
+            min_data_in_leaf=self.get("minDataInLeaf"),
+            min_sum_hessian_in_leaf=self.get("minSumHessianInLeaf"),
+            min_gain_to_split=self.get("minGainToSplit"),
+            feature_fraction=self.get("featureFraction"),
+            bagging_fraction=self.get("baggingFraction"),
+            bagging_freq=self.get("baggingFreq"),
+            boosting_type=self.get("boostingType"),
+            top_rate=self.get("topRate"),
+            other_rate=self.get("otherRate"),
+            drop_rate=self.get("dropRate"),
+            skip_drop=self.get("skipDrop"),
+            num_class=num_class,
+            sigmoid=sigmoid,
+            early_stopping_round=self.get("earlyStoppingRound"),
+            metric=self.get("metric"),
+            seed=self.get("seed"),
+            **extra,
+        )
+
+
+class _LightGBMBase(Estimator, _LightGBMParams):
+    """Shared fit orchestration (LightGBMBase.train analog,
+    lightgbm/.../LightGBMBase.scala:36-65)."""
+
+    _mesh = None
+
+    def set_mesh(self, mesh) -> "_LightGBMBase":
+        """Attach a device mesh; rows are sharded over its 'dp' axis."""
+        self._mesh = mesh
+        return self
+
+    def _extract(self, df: DataFrame):
+        x = np.asarray(df.col(self.get("featuresCol")), dtype=np.float64)
+        if x.ndim != 2:
+            raise ValueError(f"featuresCol {self.get('featuresCol')!r} must "
+                             f"be a vector column")
+        y = np.asarray(df.col(self.get("labelCol")), dtype=np.float64)
+        w = None
+        if self.is_set("weightCol"):
+            w = np.asarray(df.col(self.get("weightCol")), dtype=np.float64)
+        return x, y, w
+
+    def _split_validation(self, df: DataFrame):
+        if self.is_set("validationIndicatorCol"):
+            mask = np.asarray(df.col(self.get("validationIndicatorCol")), dtype=bool)
+            return df.filter(~mask), df.filter(mask)
+        return df, None
+
+    def _fit_booster(self, df: DataFrame, objective: str, num_class: int = 1,
+                     group_ids: Optional[np.ndarray] = None,
+                     extra_cfg: Optional[Dict[str, Any]] = None):
+        measures = InstrumentationMeasures()
+        train_df, valid_df = self._split_validation(df)
+        x, y, w = self._extract(train_df)
+        with measures.phase("binning"):
+            cat = self.get("categoricalSlotIndexes") or []
+            mapper = BinMapper.fit(
+                _sample_rows(x, self.get("seed")), max_bin=self.get("maxBin"),
+                categorical_features=cat)
+            binned = mapper.transform(x)
+        valid_sets = None
+        if valid_df is not None and valid_df.num_rows:
+            vx, vy, vw = self._extract(valid_df)
+            valid_sets = [(mapper.transform(vx), vy, vw)]
+        cfg = self._train_config(objective, num_class=num_class,
+                                 **(extra_cfg or {}))
+        init_model = None
+        if self.is_set("modelString"):
+            init_model = BoosterArrays.load_model_string(self.get("modelString"))
+
+        def init_scores(model, xs):
+            # raw-space warm-start scores: computed on raw features so a
+            # continued model is valid even under a different binning
+            import jax
+            return None if model is None else np.asarray(
+                jax.jit(model.predict_fn())(xs))
+
+        vx_raw = None
+        if valid_sets is not None:
+            vx_raw = np.asarray(valid_df.col(self.get("featuresCol")),
+                                dtype=np.float64)
+
+        num_batches = self.get("numBatches")
+        if num_batches and num_batches > 1:
+            # sequential warm-started batches (LightGBMBase.scala:45-60)
+            parts = np.array_split(np.arange(len(binned)), num_batches)
+            result = None
+            for part in parts:
+                result = train(
+                    binned[part], y[part], cfg,
+                    weights=None if w is None else w[part],
+                    group_ids=None if group_ids is None else group_ids[part],
+                    bin_upper=mapper.bin_upper_values(cfg.max_bin),
+                    valid_sets=valid_sets, init_model=init_model,
+                    init_raw=init_scores(init_model, x[part]),
+                    valid_init_raws=None if (init_model is None or vx_raw is None)
+                    else [init_scores(init_model, vx_raw)],
+                    mesh=self._mesh, measures=measures)
+                init_model = result.booster
+        else:
+            result = train(
+                binned, y, cfg, weights=w, group_ids=group_ids,
+                bin_upper=mapper.bin_upper_values(cfg.max_bin),
+                valid_sets=valid_sets, init_model=init_model,
+                init_raw=init_scores(init_model, x),
+                valid_init_raws=None if (init_model is None or vx_raw is None)
+                else [init_scores(init_model, vx_raw)],
+                mesh=self._mesh, measures=measures)
+        return result, mapper, measures
+
+
+class _LightGBMModelBase(Model, _LightGBMParams):
+    """Shared transform/scoring (LightGBMModelMethods analog)."""
+
+    booster: Optional[BoosterArrays] = None
+    train_measures: Optional[InstrumentationMeasures] = None
+    evals_result: Optional[List[Dict[str, float]]] = None
+    best_iteration: int = -1
+
+    def _init_empty(self):
+        self.booster = None
+
+    def _get_state(self) -> Dict[str, Any]:
+        state = self.booster.state_dict()
+        state["best_iteration"] = self.best_iteration
+        return state
+
+    def _set_state(self, state: Dict[str, Any]) -> None:
+        self.booster = BoosterArrays.from_state_dict(state)
+        self.best_iteration = state.get("best_iteration", -1)
+
+    # -- reference model methods -------------------------------------------
+    def get_feature_importances(self, importance_type: str = "split") -> np.ndarray:
+        return self.booster.feature_importances(importance_type)
+
+    def save_native_model(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.booster.save_model_string())
+
+    def get_model_string(self) -> str:
+        return self.booster.save_model_string()
+
+    @classmethod
+    def load_native_model_from_file(cls, path: str, **params: Any):
+        with open(path) as f:
+            return cls.load_native_model_from_string(f.read(), **params)
+
+    @classmethod
+    def load_native_model_from_string(cls, text: str, **params: Any):
+        model = cls(**params)
+        model.booster = BoosterArrays.load_model_string(text)
+        return model
+
+    def _features(self, df: DataFrame) -> np.ndarray:
+        x = np.asarray(df.col(self.get("featuresCol")), dtype=np.float64)
+        if (not self.get("predictDisableShapeCheck")
+                and x.shape[1] != self.booster.num_features):
+            raise ValueError(
+                f"feature count mismatch: model has {self.booster.num_features},"
+                f" data has {x.shape[1]}")
+        return x
+
+    def _maybe_extra_cols(self, df: DataFrame, x: np.ndarray) -> DataFrame:
+        import jax
+        if self.is_set("leafPredictionCol"):
+            leaves = np.asarray(jax.jit(self.booster.leaf_index_fn())(x))
+            df = df.with_column(self.get("leafPredictionCol"),
+                                leaves.astype(np.float64))
+        if self.is_set("featuresShapCol"):
+            contribs = np.asarray(jax.jit(self.booster.contrib_fn())(x))
+            df = df.with_column(self.get("featuresShapCol"),
+                                contribs.astype(np.float64))
+        return df
+
+
+# ---------------------------------------------------------------------------
+# Classifier
+# ---------------------------------------------------------------------------
+
+class LightGBMClassifier(_LightGBMBase):
+    """Binary / multiclass GBDT classifier
+    (LightGBMClassifier.scala:32 parity)."""
+
+    rawPredictionCol = Param("rawPredictionCol", "raw margin column", to_str,
+                             default="rawPrediction")
+    probabilityCol = Param("probabilityCol", "probability column", to_str,
+                           default="probability")
+    thresholds = Param("thresholds", "per-class prediction thresholds",
+                       to_list(to_float))
+    isUnbalance = Param("isUnbalance", "auto-weight unbalanced binary labels",
+                        to_bool, default=False)
+
+    def _fit(self, df: DataFrame) -> "LightGBMClassificationModel":
+        y_raw = np.asarray(df.col(self.get("labelCol")), dtype=np.float64)
+        classes = np.unique(y_raw[~np.isnan(y_raw)])
+        num_class = len(classes)
+        objective = self.get("objective") or (
+            "binary" if num_class <= 2 else "multiclass")
+        if objective == "binary" and num_class > 2:
+            raise ValueError(f"binary objective with {num_class} classes")
+        # re-encode labels to 0..K-1 (objectives one-hot by index)
+        encoded = np.searchsorted(classes, y_raw).astype(np.float64)
+        df = df.with_column(self.get("labelCol"), encoded)
+        if self.get("isUnbalance") and objective == "binary":
+            # scale positive-class rows by neg/pos (LightGBM is_unbalance)
+            pos = max(float((encoded == 1).sum()), 1.0)
+            neg = float((encoded == 0).sum())
+            w = np.where(encoded == 1, neg / pos, 1.0)
+            if self.is_set("weightCol"):
+                w = w * np.asarray(df.col(self.get("weightCol")), np.float64)
+                df = df.with_column(self.get("weightCol"), w)
+            else:
+                df = df.with_column("_unbalance_weight", w)
+                self = self.copy(weightCol="_unbalance_weight")
+        extra: Dict[str, Any] = {}
+        result, mapper, measures = self._fit_booster(
+            df, objective, num_class=num_class if objective != "binary" else 1,
+            extra_cfg=extra)
+        model = LightGBMClassificationModel(
+            **{k: v for k, v in self._paramMap.items()
+               if LightGBMClassificationModel.has_param(k)})
+        model.booster = result.booster
+        model.num_classes = num_class
+        model.classes_ = classes
+        model.train_measures = measures
+        model.evals_result = result.evals
+        model.best_iteration = result.best_iteration
+        return model
+
+
+class LightGBMClassificationModel(_LightGBMModelBase):
+    rawPredictionCol = Param("rawPredictionCol", "raw margin column", to_str,
+                             default="rawPrediction")
+    probabilityCol = Param("probabilityCol", "probability column", to_str,
+                           default="probability")
+    thresholds = Param("thresholds", "per-class prediction thresholds",
+                       to_list(to_float))
+    num_classes: int = 2
+    classes_: Optional[np.ndarray] = None  # original label values, sorted
+
+    def _get_state(self):
+        state = super()._get_state()
+        state["num_classes"] = self.num_classes
+        if self.classes_ is not None:
+            state["classes_"] = self.classes_
+        return state
+
+    def _set_state(self, state):
+        super()._set_state(state)
+        self.num_classes = state.get("num_classes", 2)
+        c = state.get("classes_")
+        self.classes_ = None if c is None else np.asarray(c)
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        import jax
+        import jax.numpy as jnp
+
+        x = self._features(df)
+        raw = np.asarray(jax.jit(self.booster.predict_fn())(x))
+        if raw.ndim == 1:  # binary: margins for [neg, pos]
+            raw2 = np.stack([-raw, raw], axis=1)
+            prob = 1.0 / (1.0 + np.exp(-raw))
+            probs = np.stack([1 - prob, prob], axis=1)
+        else:
+            raw2 = raw
+            probs = np.asarray(jnp.asarray(raw))
+            probs = np.exp(probs - probs.max(axis=1, keepdims=True))
+            probs = probs / probs.sum(axis=1, keepdims=True)
+        if self.is_set("thresholds"):
+            t = np.asarray(self.get("thresholds"), dtype=np.float64)
+            pred_idx = np.argmax(probs / t[None, :], axis=1)
+        else:
+            pred_idx = np.argmax(probs, axis=1)
+        if self.classes_ is not None:  # decode back to original label values
+            pred = self.classes_[pred_idx].astype(np.float64)
+        else:
+            pred = pred_idx.astype(np.float64)
+        out = (df.with_column(self.get("rawPredictionCol"), raw2)
+                 .with_column(self.get("probabilityCol"), probs)
+                 .with_column(self.get("predictionCol"), pred))
+        return self._maybe_extra_cols(out, x)
+
+
+# ---------------------------------------------------------------------------
+# Regressor
+# ---------------------------------------------------------------------------
+
+class LightGBMRegressor(_LightGBMBase):
+    """GBDT regressor incl. quantile/tweedie/poisson objectives
+    (LightGBMRegressor.scala:1 parity)."""
+
+    alpha = Param("alpha", "huber/quantile alpha", to_float, gt(0), default=0.9)
+    tweedieVariancePower = Param("tweedieVariancePower",
+                                 "tweedie variance power in (1,2)", to_float,
+                                 in_range(1, 2), default=1.5)
+
+    def _fit(self, df: DataFrame) -> "LightGBMRegressionModel":
+        objective = self.get("objective") or "regression"
+        extra = {"alpha": self.get("alpha"),
+                 "tweedie_variance_power": self.get("tweedieVariancePower")}
+        result, mapper, measures = self._fit_booster(df, objective,
+                                                     extra_cfg=extra)
+        model = LightGBMRegressionModel(
+            **{k: v for k, v in self._paramMap.items()
+               if LightGBMRegressionModel.has_param(k)})
+        model.booster = result.booster
+        model.train_measures = measures
+        model.evals_result = result.evals
+        model.best_iteration = result.best_iteration
+        return model
+
+
+class LightGBMRegressionModel(_LightGBMModelBase):
+    def _transform(self, df: DataFrame) -> DataFrame:
+        import jax
+
+        x = self._features(df)
+        raw = np.asarray(jax.jit(self.booster.predict_fn())(x))
+        if self.booster.objective in ("poisson", "gamma", "tweedie"):
+            raw = np.exp(raw)
+        out = df.with_column(self.get("predictionCol"), raw.astype(np.float64))
+        return self._maybe_extra_cols(out, x)
+
+
+# ---------------------------------------------------------------------------
+# Ranker
+# ---------------------------------------------------------------------------
+
+class LightGBMRanker(_LightGBMBase):
+    """Lambdarank ranker (LightGBMRanker.scala:1 parity). Requires a
+    ``groupCol`` of query ids; rows of a group must stay on one shard
+    (the reference repartitions by group for the same reason)."""
+
+    groupCol = Param("groupCol", "query/group id column", to_str,
+                     default="group")
+    evalAt = Param("evalAt", "NDCG@k eval positions", to_list(to_int),
+                   default=[1, 3, 5])
+
+    def _fit(self, df: DataFrame) -> "LightGBMRankerModel":
+        groups_raw = np.asarray(df.col(self.get("groupCol")))
+        _, group_ids = np.unique(groups_raw, return_inverse=True)
+        result, mapper, measures = self._fit_booster(
+            df, "lambdarank", group_ids=group_ids.astype(np.int32))
+        model = LightGBMRankerModel(
+            **{k: v for k, v in self._paramMap.items()
+               if LightGBMRankerModel.has_param(k)})
+        model.booster = result.booster
+        model.train_measures = measures
+        model.evals_result = result.evals
+        model.best_iteration = result.best_iteration
+        return model
+
+
+class LightGBMRankerModel(_LightGBMModelBase):
+    def _transform(self, df: DataFrame) -> DataFrame:
+        import jax
+
+        x = self._features(df)
+        raw = np.asarray(jax.jit(self.booster.predict_fn())(x))
+        out = df.with_column(self.get("predictionCol"), raw.astype(np.float64))
+        return self._maybe_extra_cols(out, x)
+
+
+def _sample_rows(x: np.ndarray, seed: int, max_sample: int = 200_000) -> np.ndarray:
+    """Bin-boundary sample (the analog of LightGBMBase.getSampledRows,
+    LightGBMBase.scala:724-749 — sample count bounded, deterministic)."""
+    if len(x) <= max_sample:
+        return x
+    rng = np.random.default_rng(seed)
+    return x[rng.choice(len(x), size=max_sample, replace=False)]

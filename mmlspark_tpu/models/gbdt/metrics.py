@@ -1,0 +1,163 @@
+"""Evaluation metrics for training-time eval + early stopping.
+
+Parity surface: the metrics LightGBM evaluates each iteration in the
+reference's training loop (TrainUtils.getValidEvalResults early-stop
+semantics, lightgbm/.../TrainUtils.scala:143-169). Each metric maps
+raw scores -> scalar; ``higher_better`` drives the early-stop direction
+exactly as LightGBM's per-metric flag does.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _w(weights, like):
+    return jnp.ones_like(like) if weights is None else weights
+
+
+def binary_logloss(raw, labels, weights=None):
+    p = jax.nn.sigmoid(raw)
+    p = jnp.clip(p, 1e-15, 1 - 1e-15)
+    w = _w(weights, raw)
+    ll = -(labels * jnp.log(p) + (1 - labels) * jnp.log1p(-p))
+    return jnp.sum(ll * w) / jnp.sum(w)
+
+
+def binary_error(raw, labels, weights=None):
+    pred = (raw > 0).astype(raw.dtype)
+    w = _w(weights, raw)
+    return jnp.sum((pred != labels) * w) / jnp.sum(w)
+
+
+def auc(raw, labels, weights=None):
+    """Weighted ROC-AUC via the rank statistic with true midranks for
+    tied scores (ties share the average of their rank range, so the
+    value is permutation-invariant; constant scores give exactly 0.5)."""
+    w = _w(weights, raw)
+    order = jnp.argsort(raw)
+    s, sw, sy = raw[order], w[order], labels[order]
+    cum = jnp.cumsum(sw)
+    left = jnp.searchsorted(s, s, side="left")
+    right = jnp.searchsorted(s, s, side="right")
+    below = jnp.where(left > 0, cum[jnp.maximum(left - 1, 0)], 0.0)
+    upto = cum[right - 1]
+    midrank = (below + upto) / 2.0
+    pos = jnp.sum(sw * sy)
+    neg = jnp.sum(sw) - pos
+    pos_rank = jnp.sum(midrank * sw * sy)
+    u = pos_rank - pos * pos / 2.0
+    return jnp.where((pos > 0) & (neg > 0), u / (pos * neg), 0.5)
+
+
+def multi_logloss(raw, labels, weights=None):
+    logp = jax.nn.log_softmax(raw, axis=-1)
+    ll = -jnp.take_along_axis(logp, labels.astype(jnp.int32)[:, None], 1)[:, 0]
+    w = _w(weights, ll)
+    return jnp.sum(ll * w) / jnp.sum(w)
+
+
+def multi_error(raw, labels, weights=None):
+    pred = jnp.argmax(raw, axis=-1)
+    w = _w(weights, pred.astype(raw.dtype))
+    return jnp.sum((pred != labels.astype(pred.dtype)) * w) / jnp.sum(w)
+
+
+def l2(raw, labels, weights=None):
+    w = _w(weights, raw)
+    return jnp.sum((raw - labels) ** 2 * w) / jnp.sum(w)
+
+
+def rmse(raw, labels, weights=None):
+    return jnp.sqrt(l2(raw, labels, weights))
+
+
+def l1(raw, labels, weights=None):
+    w = _w(weights, raw)
+    return jnp.sum(jnp.abs(raw - labels) * w) / jnp.sum(w)
+
+
+def mape_metric(raw, labels, weights=None):
+    w = _w(weights, raw)
+    e = jnp.abs(raw - labels) / jnp.maximum(jnp.abs(labels), 1.0)
+    return jnp.sum(e * w) / jnp.sum(w)
+
+
+def poisson_deviance(raw, labels, weights=None):
+    # raw is log(mean)
+    w = _w(weights, raw)
+    d = jnp.exp(raw) - labels * raw
+    return jnp.sum(d * w) / jnp.sum(w)
+
+
+def quantile_loss(raw, labels, weights=None, alpha: float = 0.5):
+    w = _w(weights, raw)
+    d = labels - raw
+    loss = jnp.maximum(alpha * d, (alpha - 1) * d)
+    return jnp.sum(loss * w) / jnp.sum(w)
+
+
+def ndcg_at(k: int):
+    def ndcg(raw, labels, weights=None, group_ids=None):
+        from mmlspark_tpu.models.gbdt.objectives import group_ranks
+
+        if group_ids is None:
+            raise ValueError("ndcg requires group_ids")
+        same = group_ids[:, None] == group_ids[None, :]
+        pred_rank = group_ranks(raw, group_ids)
+        ideal_rank = group_ranks(labels, group_ids)
+        gain = 2.0 ** labels - 1.0
+        dcg_t = jnp.where(pred_rank < k, gain / jnp.log2(2.0 + pred_rank), 0.0)
+        idcg_t = jnp.where(ideal_rank < k, gain / jnp.log2(2.0 + ideal_rank), 0.0)
+        samef = same.astype(raw.dtype)
+        dcg_g = samef @ dcg_t
+        idcg_g = jnp.maximum(samef @ idcg_t, 1e-12)
+        # every row carries its group's NDCG; weight rows by 1/group_size
+        # so each group counts once in the mean
+        gsize = jnp.sum(samef, axis=1)
+        per_row_ndcg = dcg_g / idcg_g
+        num_groups = jnp.sum(1.0 / gsize)
+        return jnp.sum(per_row_ndcg / gsize) / num_groups
+
+    ndcg.__name__ = f"ndcg@{k}"
+    return ndcg
+
+
+# name -> (fn, higher_better)
+METRICS: Dict[str, Tuple[Callable, bool]] = {
+    "binary_logloss": (binary_logloss, False),
+    "binary_error": (binary_error, False),
+    "auc": (auc, True),
+    "multi_logloss": (multi_logloss, False),
+    "multi_error": (multi_error, False),
+    "l2": (l2, False),
+    "mse": (l2, False),
+    "rmse": (rmse, False),
+    "l1": (l1, False),
+    "mae": (l1, False),
+    "mape": (mape_metric, False),
+    "poisson": (poisson_deviance, False),
+    "quantile": (quantile_loss, False),
+    "ndcg": (ndcg_at(5), True),
+}
+
+
+def default_metric(objective: str) -> str:
+    if objective == "binary":
+        return "binary_logloss"
+    if objective in ("multiclass", "softmax", "multiclassova"):
+        return "multi_logloss"
+    if objective == "lambdarank":
+        return "ndcg"
+    if objective in ("regression_l1", "l1", "mae"):
+        return "l1"
+    if objective == "quantile":
+        return "quantile"
+    if objective == "poisson":
+        return "poisson"
+    if objective == "mape":
+        return "mape"
+    return "l2"

@@ -1,0 +1,24 @@
+from mmlspark_tpu.models.vw.featurizer import (  # noqa: F401
+    VowpalWabbitFeaturizer,
+    VowpalWabbitInteractions,
+)
+from mmlspark_tpu.models.vw.learners import (  # noqa: F401
+    VowpalWabbitClassificationModel,
+    VowpalWabbitClassifier,
+    VowpalWabbitGeneric,
+    VowpalWabbitGenericModel,
+    VowpalWabbitGenericProgressive,
+    VowpalWabbitRegressionModel,
+    VowpalWabbitRegressor,
+)
+from mmlspark_tpu.models.vw.bandit import (  # noqa: F401
+    VowpalWabbitContextualBandit,
+    VowpalWabbitContextualBanditModel,
+)
+from mmlspark_tpu.models.vw.policyeval import (  # noqa: F401
+    BanditEstimator,
+    cressie_read,
+    cressie_read_interval,
+    ips,
+    snips,
+)

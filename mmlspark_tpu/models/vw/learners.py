@@ -1,0 +1,405 @@
+"""VW-parity online linear learners on TPU.
+
+Replaces the reference's JNI path into VW C++ (vw/.../
+VowpalWabbitBaseLearner.scala:135-188, VowpalWabbitNative) with a
+jit-compiled minibatched online-SGD scan over hashed features:
+
+  - AdaGrad per-weight adaptivity (VW ``--adaptive``), invariant-style
+    power_t learning-rate decay, L1/L2;
+  - multiple passes with weight averaging across the ``dp`` mesh axis at
+    pass boundaries — `jax.lax.pmean` replacing VW's spanning-tree
+    allreduce (VowpalWabbitClusterUtil.scala:15-43,
+    VowpalWabbitSyncSchedule.scala:15-72);
+  - progressive (one-step-ahead) predictions
+    (VowpalWabbitBaseProgressive.scala:1);
+  - ``batchSize=1`` reproduces exact example-by-example online updates;
+    larger batches trade fidelity for TPU throughput.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (
+    HasLabelCol,
+    HasPredictionCol,
+    HasWeightCol,
+    Param,
+    ge,
+    gt,
+    one_of,
+    to_bool,
+    to_float,
+    to_int,
+    to_str,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model
+
+
+# ---------------------------------------------------------------------------
+# Device-side SGD core
+# ---------------------------------------------------------------------------
+
+def _loss_grad(loss: str, pred, y, quantile_tau: float = 0.5):
+    import jax
+    import jax.numpy as jnp
+
+    if loss == "squared":
+        return pred - y
+    if loss == "logistic":
+        # y in {0,1}; VW uses {-1,1} internally — same gradient
+        return jax.nn.sigmoid(pred) - y
+    if loss == "hinge":
+        s = 2.0 * y - 1.0
+        return jnp.where(s * pred < 1.0, -s, 0.0)
+    if loss == "quantile":
+        d = pred - y
+        return jnp.where(d >= 0, 1.0 - quantile_tau, -quantile_tau)
+    raise ValueError(f"unknown loss {loss!r}")
+
+
+def make_sgd_train(num_weights: int, loss: str, learning_rate: float,
+                   power_t: float, initial_t: float, adaptive: bool,
+                   l1: float, l2: float, quantile_tau: float = 0.5,
+                   progressive: bool = False):
+    """Build jittable (w, g2, bias, t0, idx, val, y, wt) -> updated state
+    scanning over leading batch dim. Shapes: idx/val (B, W), y/wt (B,)."""
+    import jax
+    import jax.numpy as jnp
+
+    def step(carry, batch):
+        w, g2, bias, t = carry
+        idx, val, y, wt = batch
+        pred = jnp.sum(w[idx] * val, axis=-1) + bias
+        dldp = _loss_grad(loss, pred, y, quantile_tau) * wt
+        batch_n = jnp.maximum(jnp.sum((wt > 0)), 1)
+        gw = jnp.zeros_like(w).at[idx.reshape(-1)].add(
+            (dldp[:, None] * val).reshape(-1) / batch_n)
+        gb = jnp.sum(dldp) / batch_n
+        if l2:
+            gw = gw + l2 * w
+        lr_t = learning_rate * (initial_t / (initial_t + t)) ** power_t
+        if adaptive:
+            g2 = g2 + gw * gw
+            w = w - lr_t * gw / jnp.sqrt(g2 + 1e-8)
+        else:
+            w = w - lr_t * gw
+        if l1:
+            w = jnp.sign(w) * jnp.maximum(jnp.abs(w) - lr_t * l1, 0.0)
+        bias = bias - lr_t * gb
+        out = pred if progressive else jnp.zeros(())
+        return (w, g2, bias, t + 1.0), out
+
+    def run(w, g2, bias, t0, idx, val, y, wt):
+        (w, g2, bias, t), preds = jax.lax.scan(
+            step, (w, g2, bias, t0), (idx, val, y, wt))
+        return w, g2, bias, t, preds
+
+    return run
+
+
+def _batchify(idx: np.ndarray, val: np.ndarray, y: np.ndarray,
+              wt: np.ndarray, batch_size: int):
+    """Pad rows to a batch multiple (padding weight 0) and reshape to
+    (num_batches, batch, ...)."""
+    n, wdt = idx.shape
+    nb = (n + batch_size - 1) // batch_size
+    pad = nb * batch_size - n
+    if pad:
+        idx = np.concatenate([idx, np.zeros((pad, wdt), idx.dtype)])
+        val = np.concatenate([val, np.zeros((pad, wdt), val.dtype)])
+        y = np.concatenate([y, np.zeros(pad, y.dtype)])
+        wt = np.concatenate([wt, np.zeros(pad, wt.dtype)])
+    return (idx.reshape(nb, batch_size, wdt), val.reshape(nb, batch_size, wdt),
+            y.reshape(nb, batch_size), wt.reshape(nb, batch_size))
+
+
+# ---------------------------------------------------------------------------
+# Params / base classes
+# ---------------------------------------------------------------------------
+
+class _VWParams(HasLabelCol, HasWeightCol, HasPredictionCol):
+    featuresCol = Param("featuresCol", "hashed feature block prefix (expects "
+                        "<name>_idx / <name>_val columns from "
+                        "VowpalWabbitFeaturizer)", to_str, default="features")
+    numBits = Param("numBits", "hash-space bits", to_int, ge(1), default=18)
+    numPasses = Param("numPasses", "passes over the data", to_int, ge(1),
+                      default=1)
+    learningRate = Param("learningRate", "base learning rate", to_float, gt(0),
+                         default=0.5)
+    powerT = Param("powerT", "lr decay exponent", to_float, ge(0), default=0.5)
+    initialT = Param("initialT", "lr schedule offset", to_float, gt(0),
+                     default=1.0)
+    adaptive = Param("adaptive", "AdaGrad per-weight rates (--adaptive)",
+                     to_bool, default=False)
+    l1 = Param("l1", "L1 regularization", to_float, ge(0), default=0.0)
+    l2 = Param("l2", "L2 regularization", to_float, ge(0), default=0.0)
+    batchSize = Param("batchSize", "rows per online update (1 = exact "
+                      "example-wise VW semantics)", to_int, ge(1), default=16)
+    interPassSync = Param("interPassSync", "average weights across the dp "
+                          "mesh axis at pass boundaries", to_bool, default=True)
+    seed = Param("seed", "seed", to_int, default=0)
+    passThroughArgs = Param("passThroughArgs", "VW-style argument string; "
+                            "recognized flags are mapped onto params "
+                            "(ParamsStringBuilder analog)", to_str, default="")
+
+    def _apply_pass_through(self) -> Dict[str, Any]:
+        """Parse a VW arg string into param overrides (the reverse of the
+        reference's ParamsStringBuilder rendering)."""
+        args = (self.get("passThroughArgs") or "").split()
+        out: Dict[str, Any] = {}
+        i = 0
+        while i < len(args):
+            a = args[i]
+            def take():
+                nonlocal i
+                i += 1
+                return args[i]
+            if a in ("--adaptive",):
+                out["adaptive"] = True
+            elif a in ("-l", "--learning_rate"):
+                out["learningRate"] = float(take())
+            elif a == "--power_t":
+                out["powerT"] = float(take())
+            elif a == "--initial_t":
+                out["initialT"] = float(take())
+            elif a == "--l1":
+                out["l1"] = float(take())
+            elif a == "--l2":
+                out["l2"] = float(take())
+            elif a in ("-b", "--bit_precision"):
+                out["numBits"] = int(take())
+            elif a == "--passes":
+                out["numPasses"] = int(take())
+            i += 1
+        return out
+
+
+class _VWBaseLearner(Estimator, _VWParams):
+    _loss = "squared"
+    _mesh = None
+
+    def set_mesh(self, mesh):
+        self._mesh = mesh
+        return self
+
+    def _get_features(self, df: DataFrame) -> Tuple[np.ndarray, np.ndarray]:
+        base = self.get("featuresCol")
+        if f"{base}_idx" in df:
+            return (df.col(f"{base}_idx").astype(np.int32),
+                    df.col(f"{base}_val").astype(np.float32))
+        # dense vector column fallback: identity indexing
+        x = df.col(base)
+        if x.ndim != 2:
+            raise ValueError(f"featuresCol {base!r}: need <{base}_idx/_val> "
+                             f"hashed columns or a dense vector column")
+        idx = np.broadcast_to(np.arange(x.shape[1], dtype=np.int32), x.shape)
+        return idx.copy(), x.astype(np.float32)
+
+    def _train_weights(self, df: DataFrame, progressive: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        overrides = self._apply_pass_through()
+        get = lambda k: overrides.get(k, self.get(k))
+        idx, val = self._get_features(df)
+        y = np.asarray(df.col(self.get("labelCol")), dtype=np.float32)
+        wt = (np.asarray(df.col(self.get("weightCol")), dtype=np.float32)
+              if self.is_set("weightCol") else np.ones(len(y), np.float32))
+        num_weights = 1 << get("numBits")
+        if int(idx.max(initial=0)) >= num_weights:
+            raise ValueError("feature indices exceed numBits hash space; "
+                             "featurizer and learner numBits must match")
+        run = make_sgd_train(
+            num_weights, self._loss, get("learningRate"), get("powerT"),
+            get("initialT"), get("adaptive"), get("l1"), get("l2"),
+            quantile_tau=0.5, progressive=progressive)
+        bidx, bval, by, bwt = _batchify(idx, val, y, wt, get("batchSize"))
+        mesh = self._mesh
+        if mesh is not None and self.get("interPassSync"):
+            # sharded online training: each dp shard scans its own batch
+            # stream, weights are pmean-averaged at the pass boundary —
+            # the VW spanning-tree allreduce analog
+            # (VowpalWabbitSyncSchedule.scala:15-72)
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            from mmlspark_tpu.parallel.mesh import DATA_AXIS, axis_size
+
+            ndev = axis_size(mesh, DATA_AXIS)
+            nb = bidx.shape[0]
+            nb_pad = ((nb + ndev - 1) // ndev) * ndev
+            if nb_pad != nb:
+                def padb(a):
+                    return np.concatenate(
+                        [a, np.zeros((nb_pad - nb,) + a.shape[1:], a.dtype)])
+                bidx, bval, by, bwt = map(padb, (bidx, bval, by, bwt))
+
+            def sharded_pass(w, g2, bias, t, bi, bv, byy, bw):
+                # mark the replicated carry as device-varying so the scan
+                # carry type stays consistent once batch data flows in
+                w, g2, bias, t = jax.lax.pvary((w, g2, bias, t), DATA_AXIS)
+                w, g2, bias, t, preds = run(w, g2, bias, t, bi, bv, byy, bw)
+                w = jax.lax.pmean(w, DATA_AXIS)
+                g2 = jax.lax.pmean(g2, DATA_AXIS)
+                bias = jax.lax.pmean(bias, DATA_AXIS)
+                t = jax.lax.pmean(t, DATA_AXIS)
+                return w, g2, bias, t, preds
+
+            batch_spec = P(DATA_AXIS)
+            run_pass = jax.jit(shard_map(
+                sharded_pass, mesh=mesh,
+                in_specs=(P(), P(), P(), P(), batch_spec, batch_spec,
+                          batch_spec, batch_spec),
+                out_specs=(P(), P(), P(), P(), batch_spec)))
+        else:
+            run_pass = jax.jit(run)
+        w = jnp.zeros(num_weights, dtype=jnp.float32)
+        g2 = jnp.zeros(num_weights, dtype=jnp.float32)
+        bias = jnp.zeros(())
+        t = jnp.ones(()) * 0.0
+        all_preds = []
+        for p in range(get("numPasses")):
+            w, g2, bias, t, preds = run_pass(w, g2, bias, t,
+                                             jnp.asarray(bidx), jnp.asarray(bval),
+                                             jnp.asarray(by), jnp.asarray(bwt))
+            if progressive and p == 0:
+                all_preds = np.asarray(preds).reshape(-1)[:len(y)]
+        state = {
+            "weights": np.asarray(w),
+            "g2": np.asarray(g2),
+            "bias": float(bias),
+            "loss": self._loss,
+        }
+        return state, (np.asarray(all_preds) if progressive else None)
+
+    def _make_model(self, model_cls, state):
+        model = model_cls(**{k: v for k, v in self._paramMap.items()
+                             if model_cls.has_param(k)})
+        model.weights = state["weights"]
+        model.bias = state["bias"]
+        model.loss = state["loss"]
+        return model
+
+
+class _VWBaseModel(Model, _VWParams):
+    weights: Optional[np.ndarray] = None
+    bias: float = 0.0
+    loss: str = "squared"
+
+    rawPredictionCol = Param("rawPredictionCol", "margin column", to_str,
+                             default="rawPrediction")
+
+    def _get_state(self):
+        return {"weights": self.weights, "bias": self.bias, "loss": self.loss}
+
+    def _set_state(self, state):
+        self.weights = np.asarray(state["weights"])
+        self.bias = float(state["bias"])
+        self.loss = state["loss"]
+
+    def _margin(self, df: DataFrame) -> np.ndarray:
+        base = self.get("featuresCol")
+        if f"{base}_idx" in df:
+            idx = df.col(f"{base}_idx").astype(np.int64)
+            val = df.col(f"{base}_val").astype(np.float64)
+            return (self.weights[idx] * val).sum(axis=1) + self.bias
+        x = df.col(base)
+        return x @ self.weights[:x.shape[1]] + self.bias
+
+    def get_performance_statistics(self) -> Dict[str, Any]:
+        """TrainingStats analog (VowpalWabbitBaseLearner.scala:20-59)."""
+        return {"numWeights": int((np.abs(self.weights) > 0).sum()),
+                "bias": self.bias, "loss": self.loss}
+
+
+# ---------------------------------------------------------------------------
+# Public learners
+# ---------------------------------------------------------------------------
+
+class VowpalWabbitRegressor(_VWBaseLearner):
+    """Linear regression via online SGD (VowpalWabbitRegressor.scala:1)."""
+
+    lossFunction = Param("lossFunction", "squared | quantile", to_str,
+                         one_of("squared", "quantile"), default="squared")
+
+    def _fit(self, df: DataFrame) -> "VowpalWabbitRegressionModel":
+        self._loss = self.get("lossFunction")
+        state, _ = self._train_weights(df)
+        return self._make_model(VowpalWabbitRegressionModel, state)
+
+
+class VowpalWabbitRegressionModel(_VWBaseModel):
+    def _transform(self, df: DataFrame) -> DataFrame:
+        return df.with_column(self.get("predictionCol"), self._margin(df))
+
+
+class VowpalWabbitClassifier(_VWBaseLearner):
+    """Binary logistic classifier (VowpalWabbitClassifier.scala:1)."""
+
+    _loss = "logistic"
+    lossFunction = Param("lossFunction", "logistic | hinge", to_str,
+                         one_of("logistic", "hinge"), default="logistic")
+
+    def _fit(self, df: DataFrame) -> "VowpalWabbitClassificationModel":
+        self._loss = self.get("lossFunction")
+        state, _ = self._train_weights(df)
+        return self._make_model(VowpalWabbitClassificationModel, state)
+
+
+class VowpalWabbitClassificationModel(_VWBaseModel):
+    probabilityCol = Param("probabilityCol", "probability column", to_str,
+                           default="probability")
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        margin = self._margin(df)
+        prob = 1.0 / (1.0 + np.exp(-margin))
+        return (df.with_column(self.get("rawPredictionCol"),
+                               np.stack([-margin, margin], axis=1))
+                  .with_column(self.get("probabilityCol"),
+                               np.stack([1 - prob, prob], axis=1))
+                  .with_column(self.get("predictionCol"),
+                               (margin > 0).astype(np.float64)))
+
+
+class VowpalWabbitGeneric(_VWBaseLearner):
+    """Raw-args learner (VowpalWabbitGeneric.scala:19): configure entirely
+    through a VW-style ``passThroughArgs`` string; loss via --loss_function."""
+
+    def _fit(self, df: DataFrame) -> "VowpalWabbitGenericModel":
+        args = (self.get("passThroughArgs") or "").split()
+        self._loss = "squared"
+        if "--loss_function" in args:
+            self._loss = args[args.index("--loss_function") + 1]
+        state, _ = self._train_weights(df)
+        return self._make_model(VowpalWabbitGenericModel, state)
+
+
+class VowpalWabbitGenericModel(_VWBaseModel):
+    def _transform(self, df: DataFrame) -> DataFrame:
+        margin = self._margin(df)
+        pred = (1.0 / (1.0 + np.exp(-margin)) if self.loss == "logistic"
+                else margin)
+        return df.with_column(self.get("predictionCol"), pred)
+
+
+class VowpalWabbitGenericProgressive(_VWBaseLearner, ):
+    """One-step-ahead training predictions as a transform
+    (VowpalWabbitGenericProgressive.scala:1): the output column holds the
+    prediction each row received *before* the model learned from it."""
+
+    def _fit(self, df: DataFrame):
+        raise TypeError("progressive mode is transform-only; call transform")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        args = (self.get("passThroughArgs") or "").split()
+        self._loss = "squared"
+        if "--loss_function" in args:
+            self._loss = args[args.index("--loss_function") + 1]
+        _, preds = self._train_weights(df, progressive=True)
+        if self._loss == "logistic":
+            preds = 1.0 / (1.0 + np.exp(-preds))
+        return df.with_column(self.get("predictionCol"), preds)

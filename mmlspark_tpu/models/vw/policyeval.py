@@ -1,0 +1,151 @@
+"""Off-policy evaluation estimators.
+
+Parity with vw/.../policyeval: IPS (Ips.scala:1), SNIPS (Snips.scala:1),
+CressieRead point estimate and confidence interval
+(CressieRead.scala:1, CressieReadInterval.scala:1, 216 LoC), plus the
+bandit-metrics accumulator (ContextualBanditMetrics,
+VowpalWabbitContextualBandit.scala:54) and Kahan summation
+(KahanSum.scala:1). The reference runs these as Spark UDAFs; here they
+are pure vectorized reductions over (probability-logged, reward,
+probability-predicted) triples.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+class KahanSum:
+    """Compensated summation (KahanSum.scala:1)."""
+
+    def __init__(self):
+        self.sum = 0.0
+        self._c = 0.0
+
+    def add(self, v: float) -> "KahanSum":
+        t = self.sum + v
+        if abs(self.sum) >= abs(v):
+            self._c += (self.sum - t) + v
+        else:
+            self._c += (v - t) + self.sum
+        self.sum = t
+        return self
+
+    @property
+    def value(self) -> float:
+        return self.sum + self._c
+
+
+def _ratios(prob_logged, reward, prob_pred, count=None):
+    prob_logged = np.asarray(prob_logged, dtype=np.float64)
+    reward = np.asarray(reward, dtype=np.float64)
+    prob_pred = np.asarray(prob_pred, dtype=np.float64)
+    count = (np.ones_like(reward) if count is None
+             else np.asarray(count, dtype=np.float64))
+    w = prob_pred / np.maximum(prob_logged, 1e-12)
+    return w, reward, count
+
+
+def ips(prob_logged, reward, prob_pred, count=None) -> float:
+    """Inverse propensity score estimate (Ips.scala:1)."""
+    w, r, c = _ratios(prob_logged, reward, prob_pred, count)
+    return float(np.sum(w * r * c) / np.maximum(np.sum(c), 1e-12))
+
+
+def snips(prob_logged, reward, prob_pred, count=None) -> float:
+    """Self-normalized IPS (Snips.scala:1)."""
+    w, r, c = _ratios(prob_logged, reward, prob_pred, count)
+    denom = np.sum(w * c)
+    return float(np.sum(w * r * c) / np.maximum(denom, 1e-12))
+
+
+def cressie_read(prob_logged, reward, prob_pred, count=None) -> float:
+    """Cressie-Read power-divergence estimator (CressieRead.scala:1):
+    solves for the dual weights that minimize chi-square divergence
+    subject to the importance-weight moment constraint."""
+    w, r, c = _ratios(prob_logged, reward, prob_pred, count)
+    n = np.sum(c)
+    wsum = np.sum(w * c)
+    w2sum = np.sum(w * w * c)
+    wrsum = np.sum(w * r * c)
+    w2rsum = np.sum(w * w * r * c)
+    denom = n * w2sum - wsum * wsum
+    if abs(denom) < 1e-12:
+        return snips(prob_logged, reward, prob_pred, count)
+    beta = (wsum * wrsum - n * w2rsum) / denom  # lagrange-dual slope
+    gamma = (wsum * w2rsum - w2sum * wrsum) / denom
+    return float(-gamma - beta)  # estimate at the constrained optimum
+
+
+def cressie_read_interval(prob_logged, reward, prob_pred, count=None,
+                          alpha: float = 0.05,
+                          reward_min: float = 0.0,
+                          reward_max: float = 1.0) -> Tuple[float, float]:
+    """Empirical-likelihood confidence interval for the CR estimate
+    (CressieReadInterval.scala:1): bisection on the reward bound whose
+    chi-square statistic crosses the (1-alpha) quantile."""
+    from scipy.stats import chi2
+
+    w, r, c = _ratios(prob_logged, reward, prob_pred, count)
+    n = max(np.sum(c), 1.0)
+    crit = chi2.ppf(1 - alpha, df=1) / (2 * n)
+
+    def stat(mu: float) -> float:
+        # profile chi-square divergence at hypothesized value mu
+        z = w * (r - mu)
+        zbar = np.sum(z * c) / n
+        zvar = np.sum(z * z * c) / n - zbar * zbar
+        if zvar < 1e-12:
+            return 0.0 if abs(zbar) < 1e-9 else np.inf
+        return zbar * zbar / (2 * zvar)
+
+    center = cressie_read(prob_logged, reward, prob_pred, count)
+    center = min(max(center, reward_min), reward_max)
+
+    def bisect(lo, hi, target_low: bool):
+        for _ in range(60):
+            mid = (lo + hi) / 2
+            if (stat(mid) > crit) == target_low:
+                lo = mid
+            else:
+                hi = mid
+        return (lo + hi) / 2
+
+    lower = bisect(reward_min, center, True)
+    upper = bisect(center, reward_max, False)
+    # note bisection direction: swap ends for the upper bound
+    upper = reward_max - (upper - center) if upper < center else upper
+    return float(lower), float(upper)
+
+
+@dataclass
+class BanditEstimator:
+    """Streaming accumulator of all policy-eval estimates
+    (ContextualBanditMetrics analog)."""
+
+    _plog: list = field(default_factory=list)
+    _r: list = field(default_factory=list)
+    _ppred: list = field(default_factory=list)
+    _c: list = field(default_factory=list)
+
+    def add(self, prob_logged: float, reward: float, prob_pred: float,
+            count: float = 1.0) -> "BanditEstimator":
+        self._plog.append(prob_logged)
+        self._r.append(reward)
+        self._ppred.append(prob_pred)
+        self._c.append(count)
+        return self
+
+    def get(self) -> Dict[str, float]:
+        if not self._plog:
+            return {}
+        args = (self._plog, self._r, self._ppred, self._c)
+        out = {"ips": ips(*args), "snips": snips(*args),
+               "cressieRead": cressie_read(*args)}
+        lo, hi = cressie_read_interval(*args)
+        out["cressieReadLower"] = lo
+        out["cressieReadUpper"] = hi
+        return out

@@ -1,0 +1,164 @@
+"""Quantile feature binning — the "reference dataset" of GBDT training.
+
+TPU-native analog of LightGBM's sampled bin-boundary construction that the
+reference drives through ``LGBM_DatasetCreateFromSampledColumn`` and then
+broadcasts as a serialized reference dataset
+(lightgbm/.../ReferenceDatasetUtils.scala:14-127). Bin boundaries are
+computed once on host from a row sample, are tiny, and are replicated to
+every device; the binned (row, feature) -> uint8/int16 matrix is what
+ships to the TPU, replacing the reference's CSR/dense native-buffer push
+path (StreamingPartitionTask.scala:203-277) — TPUs want dense blocked
+integer data, not CSR.
+
+Conventions (matching LightGBM semantics where visible to users):
+  - bin 0 is reserved for missing values (NaN);
+  - boundaries are upper edges: value v lands in the smallest bin with
+    v <= edge; the last bin catches +inf;
+  - categorical features bin by integer category id (offset by 1 to keep
+    bin 0 = missing).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class BinMapper:
+    """Per-dataset binning state: replicated, serializable."""
+
+    # upper_edges[f] has shape (num_bins_f - 1,); +inf edge implicit
+    upper_edges: List[np.ndarray]
+    is_categorical: np.ndarray          # (F,) bool
+    categories: List[Optional[np.ndarray]]  # per-feature sorted category ids
+    max_bin: int
+
+    @property
+    def num_features(self) -> int:
+        return len(self.upper_edges)
+
+    def num_bins(self, f: int) -> int:
+        if self.is_categorical[f]:
+            return len(self.categories[f]) + 1
+        return len(self.upper_edges[f]) + 2  # + catch-all last bin + missing bin
+
+    @property
+    def max_num_bins(self) -> int:
+        return max((self.num_bins(f) for f in range(self.num_features)), default=2)
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def fit(sample: np.ndarray, max_bin: int = 255,
+            categorical_features: Sequence[int] = (),
+            min_data_in_bin: int = 3) -> "BinMapper":
+        """Compute bin boundaries from a host-side row sample.
+
+        Quantile binning over distinct values, merging bins that would
+        hold fewer than ``min_data_in_bin`` sampled rows (LightGBM's
+        ``min_data_in_bin`` semantics).
+        """
+        sample = np.asarray(sample, dtype=np.float64)
+        n, num_f = sample.shape
+        cat = np.zeros(num_f, dtype=bool)
+        cat[list(categorical_features)] = True
+        edges: List[np.ndarray] = []
+        cats: List[Optional[np.ndarray]] = []
+        for f in range(num_f):
+            col = sample[:, f]
+            col = col[~np.isnan(col)]
+            if cat[f]:
+                edges.append(np.empty(0))
+                vals, counts = np.unique(col.astype(np.int64), return_counts=True)
+                cap = max_bin - 2  # rare categories overflow to the
+                if len(vals) > cap:  # missing/other bin (LightGBM-style cap)
+                    keep = np.sort(vals[np.argsort(-counts)[:cap]])
+                    vals = keep
+                cats.append(vals)
+                continue
+            cats.append(None)
+            if len(col) == 0:
+                edges.append(np.empty(0))
+                continue
+            uniq, counts = np.unique(col, return_counts=True)
+            usable_bins = max_bin - 2  # reserve missing bin + catch-all
+            if len(uniq) <= usable_bins:
+                # boundary = midpoint between adjacent distinct values
+                e = (uniq[:-1] + uniq[1:]) / 2.0
+            else:
+                # weighted quantiles over distinct values
+                cum = np.cumsum(counts)
+                total = cum[-1]
+                qs = (np.arange(1, usable_bins) / usable_bins) * total
+                idx = np.searchsorted(cum, qs)
+                idx = np.unique(np.minimum(idx, len(uniq) - 2))
+                e = (uniq[idx] + uniq[idx + 1]) / 2.0
+            if min_data_in_bin > 1 and len(e):
+                # drop edges that separate fewer than min_data_in_bin rows
+                bins = np.searchsorted(e, col, side="left")
+                counts_per = np.bincount(bins, minlength=len(e) + 1)
+                keep = []
+                acc = 0
+                for i in range(len(e)):
+                    acc += counts_per[i]
+                    if acc >= min_data_in_bin:
+                        keep.append(i)
+                        acc = 0
+                e = e[keep]
+            edges.append(e.astype(np.float64))
+        return BinMapper(edges, cat, cats, max_bin)
+
+    # -- application --------------------------------------------------------
+    def transform(self, x: np.ndarray) -> np.ndarray:
+        """Map raw features (N, F) to bin ids (N, F) int32; NaN -> bin 0."""
+        x = np.asarray(x, dtype=np.float64)
+        out = np.zeros(x.shape, dtype=np.int32)
+        for f in range(self.num_features):
+            col = x[:, f]
+            nan = np.isnan(col)
+            if self.is_categorical[f]:
+                idx = np.searchsorted(self.categories[f], col)
+                idx = np.clip(idx, 0, len(self.categories[f]) - 1)
+                hit = self.categories[f][idx] == col
+                b = np.where(hit, idx + 1, 0)
+            else:
+                b = np.searchsorted(self.upper_edges[f], col, side="left") + 1
+            out[:, f] = np.where(nan, 0, b)
+        return out
+
+    def bin_upper_values(self, total_bins: int) -> np.ndarray:
+        """(F, total_bins) raw-value upper bound per bin — lets a trained
+        model carry real-valued thresholds so prediction never needs the
+        BinMapper (the analog of LightGBM model strings carrying
+        thresholds, booster/LightGBMBooster.scala:458)."""
+        out = np.full((self.num_features, total_bins), np.inf, dtype=np.float64)
+        for f in range(self.num_features):
+            if self.is_categorical[f]:
+                ncat = len(self.categories[f])
+                out[f, 1:ncat + 1] = self.categories[f]
+            else:
+                e = self.upper_edges[f]
+                out[f, 1:len(e) + 1] = e
+            out[f, 0] = np.nan  # missing bin has no upper value
+        return out
+
+    # -- serialization ------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "max_bin": self.max_bin,
+            "is_categorical": self.is_categorical.tolist(),
+            "upper_edges": [e.tolist() for e in self.upper_edges],
+            "categories": [None if c is None else c.tolist() for c in self.categories],
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "BinMapper":
+        return BinMapper(
+            upper_edges=[np.asarray(e, dtype=np.float64) for e in d["upper_edges"]],
+            is_categorical=np.asarray(d["is_categorical"], dtype=bool),
+            categories=[None if c is None else np.asarray(c, dtype=np.int64)
+                        for c in d["categories"]],
+            max_bin=d["max_bin"],
+        )

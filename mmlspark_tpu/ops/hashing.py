@@ -1,0 +1,82 @@
+"""MurmurHash3 (x86 32-bit) — VW-compatible feature hashing.
+
+The reference hashes features through VW's murmur variant with a cached
+namespace prefix (vw/.../VowpalWabbitMurmurWithPrefix.scala:1,
+VowpalWabbitFeaturizer.scala:1). Implemented here from the public
+MurmurHash3 spec; scalar path for strings (host, cached per vocab) and a
+vectorized path for integer index streams.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Union
+
+import numpy as np
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+
+
+def _rotl32(x: np.uint32, r: int) -> np.uint32:
+    x = np.uint32(x)
+    return np.uint32((np.uint64(x) << np.uint64(r) | (np.uint64(x) >> np.uint64(32 - r))) & np.uint64(0xFFFFFFFF))
+
+
+def murmur3_32(data: Union[bytes, str], seed: int = 0) -> int:
+    """Scalar MurmurHash3_x86_32."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    with np.errstate(over="ignore"):
+        h = np.uint32(seed)
+        n = len(data)
+        nblocks = n // 4
+        for i in range(nblocks):
+            k = np.uint32(int.from_bytes(data[4 * i:4 * i + 4], "little"))
+            k = np.uint32(k * _C1)
+            k = _rotl32(k, 15)
+            k = np.uint32(k * _C2)
+            h = np.uint32(h ^ k)
+            h = _rotl32(h, 13)
+            h = np.uint32(h * np.uint32(5) + np.uint32(0xE6546B64))
+        tail = data[nblocks * 4:]
+        k = np.uint32(0)
+        if len(tail) >= 3:
+            k = np.uint32(k ^ np.uint32(tail[2] << 16))
+        if len(tail) >= 2:
+            k = np.uint32(k ^ np.uint32(tail[1] << 8))
+        if len(tail) >= 1:
+            k = np.uint32(k ^ np.uint32(tail[0]))
+            k = np.uint32(k * _C1)
+            k = _rotl32(k, 15)
+            k = np.uint32(k * _C2)
+            h = np.uint32(h ^ k)
+        h = np.uint32(h ^ np.uint32(n))
+        h = np.uint32(h ^ (h >> np.uint32(16)))
+        h = np.uint32(h * np.uint32(0x85EBCA6B))
+        h = np.uint32(h ^ (h >> np.uint32(13)))
+        h = np.uint32(h * np.uint32(0xC2B2AE35))
+        h = np.uint32(h ^ (h >> np.uint32(16)))
+        return int(h)
+
+
+@lru_cache(maxsize=65536)
+def hash_feature(name: str, seed: int = 0) -> int:
+    """Cached string-feature hash (the MurmurWithPrefix cache analog)."""
+    return murmur3_32(name, seed)
+
+
+def interact_hash(a: np.ndarray, b: np.ndarray, num_bits: int) -> np.ndarray:
+    """Combine two hashed index arrays for quadratic interactions
+    (VW's FNV-style pair combination), masked to num_bits."""
+    mask = (1 << num_bits) - 1
+    with np.errstate(over="ignore"):
+        combined = a.astype(np.uint64) * np.uint64(0x100000001B3) + b.astype(np.uint64)
+    return (combined & np.uint64(mask)).astype(np.int32)
+
+
+def mask_bits(h: Union[int, np.ndarray], num_bits: int):
+    mask = (1 << num_bits) - 1
+    if isinstance(h, np.ndarray):
+        return (h & mask).astype(np.int32)
+    return int(h) & mask

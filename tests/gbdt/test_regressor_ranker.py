@@ -1,0 +1,132 @@
+"""Regressor/ranker accuracy benchmarks.
+
+Energy-efficiency-style L2 regression across boosting types mirrors
+benchmarks_VerifyLightGBMRegressorBulk.csv; lambdarank NDCG mirrors the
+MSLR barrier-mode config tracked in BASELINE.md.
+"""
+
+import numpy as np
+import pytest
+from sklearn.datasets import fetch_california_housing, make_regression
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.models.gbdt import (
+    LightGBMRanker,
+    LightGBMRegressionModel,
+    LightGBMRegressor,
+)
+
+
+def regression_df(n=800, seed=0):
+    X, y = make_regression(n_samples=n, n_features=12, n_informative=8,
+                           noise=5.0, random_state=seed)
+    y = y / np.abs(y).max() * 10
+    return DataFrame({"features": X, "label": y})
+
+
+@pytest.mark.parametrize("boosting", ["gbdt", "rf", "dart", "goss"])
+def test_regression_r2_benchmark(boosting):
+    df = regression_df()
+    reg = LightGBMRegressor(
+        numIterations=60, numLeaves=31, maxDepth=5, minDataInLeaf=5,
+        boostingType=boosting,
+        baggingFraction=0.8 if boosting == "rf" else 1.0,
+        baggingFreq=1 if boosting == "rf" else 0, seed=11)
+    pred = reg.fit(df).transform(df)["prediction"]
+    y = df["label"]
+    r2 = 1 - np.sum((pred - y) ** 2) / np.sum((y - y.mean()) ** 2)
+    floor = {"gbdt": 0.9, "rf": 0.55, "dart": 0.8, "goss": 0.9}[boosting]
+    assert r2 > floor, f"{boosting}: r2={r2}"
+
+
+@pytest.mark.parametrize("objective", ["regression_l1", "huber", "quantile",
+                                       "fair", "mape"])
+def test_alt_objectives_train(objective):
+    df = regression_df(400)
+    reg = LightGBMRegressor(numIterations=20, objective=objective,
+                            minDataInLeaf=5, alpha=0.5)
+    pred = reg.fit(df).transform(df)["prediction"]
+    y = df["label"]
+    mae = np.abs(pred - y).mean()
+    assert mae < np.abs(y - np.median(y)).mean(), f"{objective}: MAE {mae}"
+
+
+@pytest.mark.parametrize("objective", ["poisson", "tweedie", "gamma"])
+def test_log_link_objectives(objective):
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(500, 5))
+    rate = np.exp(0.4 * X[:, 0] - 0.3 * X[:, 1] + 0.5)
+    y = rng.poisson(rate).astype(np.float64) + (0.01 if objective == "gamma" else 0.0)
+    df = DataFrame({"features": X, "label": y})
+    reg = LightGBMRegressor(numIterations=30, objective=objective,
+                            minDataInLeaf=10)
+    pred = reg.fit(df).transform(df)["prediction"]
+    assert np.all(pred > 0)  # log-link predictions are positive
+    corr = np.corrcoef(pred, rate)[0, 1]
+    assert corr > 0.5, f"{objective}: corr {corr}"
+
+
+def test_quantile_crossing():
+    df = regression_df(500)
+    lo = LightGBMRegressor(numIterations=30, objective="quantile", alpha=0.1,
+                           minDataInLeaf=10).fit(df).transform(df)["prediction"]
+    hi = LightGBMRegressor(numIterations=30, objective="quantile", alpha=0.9,
+                           minDataInLeaf=10).fit(df).transform(df)["prediction"]
+    # the 90th-percentile predictor should usually sit above the 10th
+    assert (hi >= lo).mean() > 0.8
+    y = df["label"]
+    assert (y <= hi).mean() > 0.6 and (y >= lo).mean() > 0.6
+
+
+def test_regressor_save_load(tmp_path):
+    df = regression_df(300)
+    model = LightGBMRegressor(numIterations=10, minDataInLeaf=5).fit(df)
+    model.save(str(tmp_path / "m"))
+    loaded = LightGBMRegressionModel.load(str(tmp_path / "m"))
+    assert np.allclose(model.transform(df)["prediction"],
+                       loaded.transform(df)["prediction"])
+
+
+def make_ranking(num_groups=30, per_group=12, seed=5):
+    rng = np.random.default_rng(seed)
+    rows = num_groups * per_group
+    X = rng.normal(size=(rows, 6))
+    group = np.repeat(np.arange(num_groups), per_group)
+    # relevance driven by two features
+    score = X[:, 0] + 0.5 * X[:, 1] + 0.3 * rng.normal(size=rows)
+    rel = np.zeros(rows)
+    for g in range(num_groups):
+        idx = np.nonzero(group == g)[0]
+        order = np.argsort(-score[idx])
+        rel[idx[order[:2]]] = 2.0
+        rel[idx[order[2:5]]] = 1.0
+    return DataFrame({"features": X, "label": rel, "query": group.astype(np.int64)})
+
+
+def ndcg_at_k(scores, labels, groups, k=5):
+    total, count = 0.0, 0
+    for g in np.unique(groups):
+        idx = np.nonzero(groups == g)[0]
+        order = np.argsort(-scores[idx])
+        gains = (2 ** labels[idx][order] - 1)[:k]
+        dcg = np.sum(gains / np.log2(np.arange(2, len(gains) + 2)))
+        ideal = np.sort(2 ** labels[idx] - 1)[::-1][:k]
+        idcg = np.sum(ideal / np.log2(np.arange(2, len(ideal) + 2)))
+        if idcg > 0:
+            total += dcg / idcg
+            count += 1
+    return total / max(count, 1)
+
+
+def test_lambdarank_beats_random():
+    df = make_ranking()
+    ranker = LightGBMRanker(numIterations=30, numLeaves=15, maxDepth=4,
+                            minDataInLeaf=3, groupCol="query")
+    model = ranker.fit(df)
+    scores = model.transform(df)["prediction"]
+    groups = df["query"]
+    ndcg = ndcg_at_k(scores, df["label"], groups)
+    rng = np.random.default_rng(0)
+    random_ndcg = ndcg_at_k(rng.normal(size=len(scores)), df["label"], groups)
+    assert ndcg > 0.8, f"ndcg={ndcg}"
+    assert ndcg > random_ndcg + 0.15
