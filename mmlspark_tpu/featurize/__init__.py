@@ -1,0 +1,18 @@
+"""Featurization (parity: reference core `featurize` package)."""
+
+from mmlspark_tpu.featurize.clean import CleanMissingData, CleanMissingDataModel
+from mmlspark_tpu.featurize.convert import DataConversion
+from mmlspark_tpu.featurize.featurize import Featurize
+from mmlspark_tpu.featurize.indexer import (IndexToValue, ValueIndexer,
+                                            ValueIndexerModel)
+from mmlspark_tpu.featurize.select import CountSelector, CountSelectorModel
+from mmlspark_tpu.featurize.text import (MultiNGram, PageSplitter,
+                                         TextFeaturizer, TextFeaturizerModel)
+from mmlspark_tpu.featurize.assemble import VectorAssembler
+
+__all__ = [
+    "CleanMissingData", "CleanMissingDataModel", "CountSelector",
+    "CountSelectorModel", "DataConversion", "Featurize", "IndexToValue",
+    "MultiNGram", "PageSplitter", "TextFeaturizer", "TextFeaturizerModel",
+    "ValueIndexer", "ValueIndexerModel", "VectorAssembler",
+]

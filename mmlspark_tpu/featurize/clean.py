@@ -1,0 +1,74 @@
+"""Missing-data imputation.
+
+Parity: featurize/CleanMissingData.scala — modes Mean / Median / Custom
+computed per column at fit time over numeric columns; the model stores
+(colsToFill, fillValues) and replaces NaN on transform.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (Param, ParamValidationError, one_of,
+                                     to_float, to_list, to_str)
+from mmlspark_tpu.core.pipeline import Estimator, Model
+
+
+class CleanMissingData(Estimator):
+    inputCols = Param("inputCols", "columns to clean", to_list(to_str))
+    outputCols = Param("outputCols", "cleaned output columns", to_list(to_str))
+    cleaningMode = Param("cleaningMode", "Mean | Median | Custom", to_str,
+                         one_of("Mean", "Median", "Custom"), default="Mean")
+    customValue = Param("customValue", "replacement for Custom mode", to_float)
+
+    def _fit(self, dataset: DataFrame) -> "CleanMissingDataModel":
+        in_cols = self.get("inputCols") or []
+        out_cols = self.get("outputCols") or in_cols
+        if len(in_cols) != len(out_cols):
+            raise ParamValidationError("inputCols/outputCols length mismatch")
+        mode = self.get("cleaningMode")
+        fills: List[float] = []
+        for c in in_cols:
+            arr = dataset.col(c)
+            if not np.issubdtype(arr.dtype, np.number):
+                raise TypeError(f"CleanMissingData: column {c!r} not numeric")
+            vals = arr.astype(np.float64)
+            valid = vals[~np.isnan(vals)]
+            if mode == "Mean":
+                fills.append(float(valid.mean()) if len(valid) else 0.0)
+            elif mode == "Median":
+                fills.append(float(np.median(valid)) if len(valid) else 0.0)
+            else:
+                cv = self.get("customValue")
+                if cv is None:
+                    raise ParamValidationError(
+                        "Custom mode requires customValue")
+                fills.append(cv)
+        model = CleanMissingDataModel(
+            inputCols=list(in_cols), outputCols=list(out_cols))
+        model.fill_values = fills
+        return model
+
+
+class CleanMissingDataModel(Model):
+    inputCols = Param("inputCols", "columns to clean", to_list(to_str))
+    outputCols = Param("outputCols", "cleaned output columns", to_list(to_str))
+
+    fill_values: List[float]
+
+    def _get_state(self):
+        return {"fill_values": self.fill_values}
+
+    def _set_state(self, state):
+        self.fill_values = state["fill_values"]
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        df = dataset
+        for c, o, fv in zip(self.get("inputCols"), self.get("outputCols"),
+                            self.fill_values):
+            vals = dataset.col(c).astype(np.float64)
+            df = df.with_column(o, np.where(np.isnan(vals), fv, vals))
+        return df

@@ -1,0 +1,64 @@
+"""Column type conversion.
+
+Parity: featurize/DataConversion.scala — converts listed columns to a
+target type: boolean, byte, short, integer, long, float, double, string,
+toCategorical, clearCategorical, date (with dateTimeFormat).
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import Param, one_of, to_list, to_str
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.featurize.indexer import ValueIndexer
+
+_NUMPY_TYPES = {
+    "boolean": np.bool_, "byte": np.int8, "short": np.int16,
+    "integer": np.int32, "long": np.int64, "float": np.float32,
+    "double": np.float64,
+}
+
+
+class DataConversion(Transformer):
+    cols = Param("cols", "columns to convert", to_list(to_str))
+    convertTo = Param("convertTo", "target type", to_str,
+                      one_of("boolean", "byte", "short", "integer", "long",
+                             "float", "double", "string", "toCategorical",
+                             "clearCategorical", "date"), default="double")
+    dateTimeFormat = Param("dateTimeFormat", "strptime format for date",
+                           to_str, default="%Y-%m-%d %H:%M:%S")
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        target = self.get("convertTo")
+        df = dataset
+        for c in self.get("cols") or []:
+            arr = dataset.col(c)
+            if target in _NUMPY_TYPES:
+                if arr.dtype == object:
+                    arr = np.asarray([float(v) for v in arr])
+                df = df.with_column(c, arr.astype(_NUMPY_TYPES[target]))
+            elif target == "string":
+                df = df.with_column(
+                    c, np.asarray([str(v) for v in arr.tolist()], dtype=object))
+            elif target == "toCategorical":
+                model = ValueIndexer(inputCol=c, outputCol=c).fit(df)
+                df = model.transform(df)
+            elif target == "clearCategorical":
+                meta = df.metadata(c)
+                levels = meta.get("levels")
+                if levels is not None:
+                    values = [levels[i] for i in df.col(c).astype(np.int64)]
+                    first = next((v for v in values if v is not None), None)
+                    dtype = object if isinstance(first, str) or first is None else None
+                    df = df.with_column(c, np.asarray(values, dtype=dtype))
+                df = df.with_metadata(c, {"categorical": False, "levels": None})
+            elif target == "date":
+                fmt = self.get("dateTimeFormat")
+                df = df.with_column(c, np.asarray(
+                    [datetime.strptime(v, fmt) if isinstance(v, str) else v
+                     for v in arr.tolist()], dtype=object))
+        return df

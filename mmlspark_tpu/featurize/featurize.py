@@ -1,0 +1,96 @@
+"""Auto-featurization to a single vector column.
+
+Parity: featurize/Featurize.scala:35- — fit() assembles a pipeline per
+column kind: numeric columns are (optionally) mean-imputed; string /
+categorical columns are value-indexed and (optionally) one-hot encoded;
+text-like high-cardinality strings are hash-featurized; everything is
+assembled into one dense feature vector sized by ``numFeatures``.
+Returns a fitted PipelineModel, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (HasOutputCol, Param, gt, to_bool, to_int,
+                                     to_list, to_str)
+from mmlspark_tpu.core.pipeline import (Estimator, Model, Pipeline,
+                                        PipelineModel, Transformer)
+from mmlspark_tpu.featurize.assemble import VectorAssembler
+from mmlspark_tpu.featurize.clean import CleanMissingData
+from mmlspark_tpu.featurize.indexer import ValueIndexer
+from mmlspark_tpu.featurize.text import TextFeaturizer
+
+# above this many distinct values a string column is treated as text and
+# hashed instead of one-hot encoded (Featurize.scala treats non-categorical
+# strings with Tokenizer+HashingTF)
+_TEXT_CARDINALITY_THRESHOLD = 64
+
+
+class _OneHot(Transformer):
+    inputCol = Param("inputCol", "indexed input column", to_str)
+    outputCol = Param("outputCol", "one-hot vector column", to_str)
+    numLevels = Param("numLevels", "number of levels", to_int, gt(0))
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        k = self.get("numLevels")
+        idx = dataset.col(self.get("inputCol")).astype(np.int64)
+        out = np.zeros((len(idx), k), dtype=np.float64)
+        out[np.arange(len(idx)), np.clip(idx, 0, k - 1)] = 1.0
+        return dataset.with_column(self.get("outputCol"), out)
+
+
+class Featurize(Estimator, HasOutputCol):
+    inputCols = Param("inputCols", "columns to featurize", to_list(to_str))
+    outputCol = Param("outputCol", "assembled feature vector", to_str,
+                      default="features")
+    oneHotEncodeCategoricals = Param("oneHotEncodeCategoricals",
+                                     "one-hot encode categoricals", to_bool,
+                                     default=True)
+    numFeatures = Param("numFeatures", "hash space for text columns", to_int,
+                        gt(0), default=1 << 12)
+    imputeMissing = Param("imputeMissing", "mean-impute numeric NaNs", to_bool,
+                          default=True)
+
+    def _fit(self, dataset: DataFrame) -> PipelineModel:
+        stages = []
+        assembled = []
+        for c in self.get("inputCols") or dataset.columns:
+            arr = dataset.col(c)
+            if arr.dtype != object and np.issubdtype(arr.dtype, np.number):
+                if (self.get("imputeMissing") and arr.ndim == 1
+                        and np.issubdtype(arr.dtype, np.floating)):
+                    stages.append(CleanMissingData(
+                        inputCols=[c], outputCols=[f"{c}__clean"]))
+                    assembled.append(f"{c}__clean")
+                else:
+                    assembled.append(c)
+            elif arr.dtype == object and len(arr) and isinstance(
+                    next((v for v in arr if v is not None), ""), str):
+                n_distinct = len({v for v in arr if v is not None})
+                if n_distinct > _TEXT_CARDINALITY_THRESHOLD:
+                    stages.append(TextFeaturizer(
+                        inputCol=c, outputCol=f"{c}__tf",
+                        numFeatures=self.get("numFeatures"), useIDF=True))
+                    assembled.append(f"{c}__tf")
+                else:
+                    stages.append(ValueIndexer(inputCol=c,
+                                               outputCol=f"{c}__idx"))
+                    if self.get("oneHotEncodeCategoricals"):
+                        has_null = any(v is None for v in arr)
+                        stages.append(_OneHot(
+                            inputCol=f"{c}__idx", outputCol=f"{c}__oh",
+                            numLevels=n_distinct + (1 if has_null else 0)))
+                        assembled.append(f"{c}__oh")
+                    else:
+                        assembled.append(f"{c}__idx")
+            elif arr.dtype == bool:
+                assembled.append(c)
+            # other object columns (lists, dates) are skipped, as in the
+            # reference's unsupported-type filter
+        stages.append(VectorAssembler(inputCols=assembled,
+                                      outputCol=self.get("outputCol")))
+        # fit the inner pipeline fully, then transform through the last stage
+        pipeline_model = Pipeline(stages=stages).fit(dataset)
+        return pipeline_model

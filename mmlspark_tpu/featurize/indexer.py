@@ -1,0 +1,95 @@
+"""Value indexing: value <-> categorical index.
+
+Parity: featurize/ValueIndexer.scala:57-105 (fit computes sorted distinct
+levels with nulls last) and featurize/IndexToValue.scala. Level order:
+ascending, nulls/NaN last (NullOrdering, ValueIndexer.scala:42-50).
+Categorical levels are recorded in column metadata — the analog of
+core/schema/Categoricals.scala metadata.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import HasInputCol, HasOutputCol
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+
+
+class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
+    def _fit(self, dataset: DataFrame) -> "ValueIndexerModel":
+        arr = dataset.col(self.get("inputCol"))
+        if arr.dtype == object:
+            non_null = sorted({v for v in arr if v is not None})
+            levels: List[Any] = list(non_null)
+            if any(v is None for v in arr):
+                levels.append(None)
+        else:
+            vals = np.unique(arr[~_nan_mask(arr)])
+            levels = [v.item() for v in vals]
+            if _nan_mask(arr).any():
+                levels.append(float("nan"))
+        model = ValueIndexerModel(inputCol=self.get("inputCol"),
+                                  outputCol=self.get("outputCol"))
+        model.levels = levels
+        return model
+
+
+def _nan_mask(arr: np.ndarray) -> np.ndarray:
+    if np.issubdtype(arr.dtype, np.floating):
+        return np.isnan(arr)
+    return np.zeros(len(arr), dtype=bool)
+
+
+class ValueIndexerModel(Model, HasInputCol, HasOutputCol):
+    levels: List[Any]
+
+    def _get_state(self):
+        return {"levels": self.levels}
+
+    def _set_state(self, state):
+        self.levels = state["levels"]
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        arr = dataset.col(self.get("inputCol"))
+        index = {}
+        nan_idx = None
+        for i, v in enumerate(self.levels):
+            if v is None or (isinstance(v, float) and np.isnan(v)):
+                nan_idx = i
+            else:
+                index[v] = i
+        out = np.empty(len(arr), dtype=np.int64)
+        for i, v in enumerate(arr.tolist()):
+            if v is None or (isinstance(v, float) and np.isnan(v)):
+                if nan_idx is None:
+                    raise ValueError(
+                        f"unseen null in column {self.get('inputCol')!r}")
+                out[i] = nan_idx
+            else:
+                if v not in index:
+                    raise ValueError(f"unseen level {v!r}")
+                out[i] = index[v]
+        df = dataset.with_column(self.get("outputCol"), out)
+        return df.with_metadata(self.get("outputCol"),
+                                {"categorical": True, "levels": self.levels})
+
+
+class IndexToValue(Transformer, HasInputCol, HasOutputCol):
+    """Inverse of ValueIndexerModel using the categorical metadata on the
+    input column (featurize/IndexToValue.scala:1)."""
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        meta = dataset.metadata(self.get("inputCol"))
+        levels = meta.get("levels")
+        if levels is None:
+            raise ValueError(
+                f"column {self.get('inputCol')!r} has no categorical levels")
+        idx = dataset.col(self.get("inputCol")).astype(np.int64)
+        values = [levels[i] for i in idx]
+        first = next((v for v in values if v is not None), None)
+        dtype = object if isinstance(first, str) or first is None else None
+        return dataset.with_column(self.get("outputCol"),
+                                   np.asarray(values, dtype=dtype))

@@ -1,0 +1,42 @@
+"""CountSelector: drop vector slots that are all-zero at fit time.
+
+Parity: featurize/CountSelector.scala — fit scans the vector column for
+slots with nonzero counts, model selects only those indices.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import HasInputCol, HasOutputCol
+from mmlspark_tpu.core.pipeline import Estimator, Model
+
+
+class CountSelector(Estimator, HasInputCol, HasOutputCol):
+    def _fit(self, dataset: DataFrame) -> "CountSelectorModel":
+        mat = np.asarray(dataset.col(self.get("inputCol")), dtype=np.float64)
+        if mat.ndim != 2:
+            raise TypeError("CountSelector expects a vector column")
+        keep = np.nonzero((mat != 0).any(axis=0))[0]
+        model = CountSelectorModel(inputCol=self.get("inputCol"),
+                                   outputCol=self.get("outputCol"))
+        model.indices = keep.tolist()
+        return model
+
+
+class CountSelectorModel(Model, HasInputCol, HasOutputCol):
+    indices: List[int]
+
+    def _get_state(self):
+        return {"indices": self.indices}
+
+    def _set_state(self, state):
+        self.indices = state["indices"]
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        mat = np.asarray(dataset.col(self.get("inputCol")))
+        return dataset.with_column(self.get("outputCol"),
+                                   mat[:, np.asarray(self.indices, dtype=np.int64)])

@@ -1,0 +1,247 @@
+"""Text featurization: tokenize -> stopwords -> ngrams -> hashing TF -> IDF.
+
+Parity: featurize/text/TextFeaturizer.scala:193- (the staged pipeline and
+its defaults), MultiNGram.scala:25- (concatenated multi-length ngrams),
+PageSplitter.scala:23- (length-bounded page splitting preserving word
+boundaries). Hashing uses murmur3 (ops/hashing.py) like Spark HashingTF;
+the TF/IDF matrix is dense ``(n, numFeatures)`` — sized for the TPU path
+where downstream learners want dense MXU-friendly inputs, so the default
+``numFeatures`` is 2^12 rather than the reference's 2^18-sparse.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (HasInputCol, HasOutputCol, Param, ge, gt,
+                                     to_bool, to_int, to_list, to_str)
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.ops.hashing import murmur3_32
+
+# a compact English stopword list (public domain; the reference defers to
+# Spark's StopWordsRemover defaults)
+ENGLISH_STOP_WORDS = frozenset("""
+a about above after again against all am an and any are as at be because been
+before being below between both but by could did do does doing down during
+each few for from further had has have having he her here hers herself him
+himself his how i if in into is it its itself just me more most my myself no
+nor not now of off on once only or other our ours ourselves out over own same
+she should so some such than that the their theirs them themselves then there
+these they this those through to too under until up very was we were what when
+where which while who whom why will with you your yours yourself yourselves
+""".split())
+
+
+def _tokenize(text: Optional[str], pattern: str, gaps: bool, lower: bool,
+              min_len: int) -> List[str]:
+    if text is None:
+        return []
+    if lower:
+        text = text.lower()
+    toks = re.split(pattern, text) if gaps else re.findall(pattern, text)
+    return [t for t in toks if len(t) >= min_len and t]
+
+
+def _ngrams(tokens: List[str], n: int) -> List[str]:
+    return [" ".join(tokens[i:i + n]) for i in range(len(tokens) - n + 1)]
+
+
+def _hash_tf(token_lists: List[List[str]], num_features: int,
+             binary: bool) -> np.ndarray:
+    out = np.zeros((len(token_lists), num_features), dtype=np.float32)
+    for i, toks in enumerate(token_lists):
+        for t in toks:
+            j = murmur3_32(t, seed=42) % num_features
+            if binary:
+                out[i, j] = 1.0
+            else:
+                out[i, j] += 1.0
+    return out
+
+
+class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
+    """End-to-end text -> TF(-IDF) vector (TextFeaturizer.scala:193)."""
+
+    useTokenizer = Param("useTokenizer", "tokenize the input", to_bool,
+                         default=True)
+    tokenizerGaps = Param("tokenizerGaps",
+                          "pattern matches gaps (split) vs tokens (findall)",
+                          to_bool, default=True)
+    minTokenLength = Param("minTokenLength", "min token length", to_int, ge(0),
+                           default=0)
+    tokenizerPattern = Param("tokenizerPattern", "token regex", to_str,
+                             default=r"\s+")
+    toLowercase = Param("toLowercase", "lowercase first", to_bool, default=True)
+    useStopWordsRemover = Param("useStopWordsRemover", "remove stop words",
+                                to_bool, default=False)
+    caseSensitiveStopWords = Param("caseSensitiveStopWords",
+                                   "case sensitive stopword match", to_bool,
+                                   default=False)
+    stopWords = Param("stopWords", "comma separated custom stopwords", to_str)
+    useNGram = Param("useNGram", "enumerate ngrams", to_bool, default=False)
+    nGramLength = Param("nGramLength", "ngram size", to_int, gt(0), default=2)
+    binary = Param("binary", "binary term counts", to_bool, default=False)
+    numFeatures = Param("numFeatures", "hash space size", to_int, gt(0),
+                        default=1 << 12)
+    useIDF = Param("useIDF", "scale by inverse document frequency", to_bool,
+                   default=True)
+    minDocFreq = Param("minDocFreq", "min document frequency for IDF", to_int,
+                       default=1)
+
+    def _tokens(self, dataset: DataFrame) -> List[List[str]]:
+        col = dataset.col(self.get("inputCol"))
+        if self.get("useTokenizer"):
+            token_lists = [
+                _tokenize(v, self.get("tokenizerPattern"),
+                          self.get("tokenizerGaps"), self.get("toLowercase"),
+                          self.get("minTokenLength"))
+                for v in col]
+        else:
+            token_lists = [list(v) if v is not None else [] for v in col]
+        if self.get("useStopWordsRemover"):
+            custom = self.get("stopWords")
+            words = (set(custom.split(",")) if custom else ENGLISH_STOP_WORDS)
+            if self.get("caseSensitiveStopWords"):
+                token_lists = [[t for t in toks if t not in words]
+                               for toks in token_lists]
+            else:
+                lower = {w.lower() for w in words}
+                token_lists = [[t for t in toks if t.lower() not in lower]
+                               for toks in token_lists]
+        if self.get("useNGram"):
+            n = self.get("nGramLength")
+            token_lists = [_ngrams(toks, n) for toks in token_lists]
+        return token_lists
+
+    def _fit(self, dataset: DataFrame) -> "TextFeaturizerModel":
+        nf = self.get("numFeatures")
+        tf = _hash_tf(self._tokens(dataset), nf, self.get("binary"))
+        model = TextFeaturizerModel(**{p.name: self.get(p.name)
+                                       for p in self.params()
+                                       if self.is_set(p.name) or p.default is not None})
+        if self.get("useIDF"):
+            df_count = (tf > 0).sum(axis=0).astype(np.float64)
+            n_docs = max(len(tf), 1)
+            idf = np.log((n_docs + 1.0) / (df_count + 1.0))
+            idf[df_count < self.get("minDocFreq")] = 0.0
+            model.idf = idf.astype(np.float32)
+        else:
+            model.idf = None
+        return model
+
+
+class TextFeaturizerModel(Model, HasInputCol, HasOutputCol):
+    # mirror of the estimator params needed at transform time
+    useTokenizer = TextFeaturizer.useTokenizer
+    tokenizerGaps = TextFeaturizer.tokenizerGaps
+    minTokenLength = TextFeaturizer.minTokenLength
+    tokenizerPattern = TextFeaturizer.tokenizerPattern
+    toLowercase = TextFeaturizer.toLowercase
+    useStopWordsRemover = TextFeaturizer.useStopWordsRemover
+    caseSensitiveStopWords = TextFeaturizer.caseSensitiveStopWords
+    stopWords = TextFeaturizer.stopWords
+    useNGram = TextFeaturizer.useNGram
+    nGramLength = TextFeaturizer.nGramLength
+    binary = TextFeaturizer.binary
+    numFeatures = TextFeaturizer.numFeatures
+    useIDF = TextFeaturizer.useIDF
+    minDocFreq = TextFeaturizer.minDocFreq
+
+    idf: Optional[np.ndarray]
+
+    _tokens = TextFeaturizer._tokens
+
+    def _get_state(self):
+        return {"idf": None if self.idf is None else self.idf.tolist()}
+
+    def _set_state(self, state):
+        idf = state.get("idf")
+        self.idf = None if idf is None else np.asarray(idf, dtype=np.float32)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        tf = _hash_tf(self._tokens(dataset), self.get("numFeatures"),
+                      self.get("binary"))
+        if self.idf is not None:
+            tf = tf * self.idf[None, :]
+        return dataset.with_column(self.get("outputCol"), tf)
+
+
+class MultiNGram(Transformer, HasInputCol, HasOutputCol):
+    """Concatenates ngrams of several lengths from a token-list column
+    (featurize/text/MultiNGram.scala:25-)."""
+
+    lengths = Param("lengths", "ngram lengths", to_list(to_int))
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        lengths = self.get("lengths") or [2]
+        col = dataset.col(self.get("inputCol"))
+        out = np.empty(len(col), dtype=object)
+        for i, toks in enumerate(col):
+            toks = list(toks) if toks is not None else []
+            merged: List[str] = []
+            for n in lengths:
+                merged.extend(_ngrams(toks, n))
+            out[i] = merged
+        return dataset.with_column(self.get("outputCol"), out)
+
+
+class PageSplitter(Transformer, HasInputCol, HasOutputCol):
+    """Splits strings into pages of [min,max] characters on word
+    boundaries (featurize/text/PageSplitter.scala:23-57): pages end at a
+    boundary once minimumPageLength chars are accumulated, and words
+    longer than a page are hard-split at maximumPageLength."""
+
+    maximumPageLength = Param("maximumPageLength", "max chars per page",
+                              to_int, gt(0), default=5000)
+    minimumPageLength = Param("minimumPageLength",
+                              "min chars before a boundary split", to_int,
+                              gt(0), default=4500)
+    boundaryRegex = Param("boundaryRegex", "word boundary regex", to_str,
+                          default=r"\s")
+
+    def _split(self, text: Optional[str]) -> Optional[List[str]]:
+        if text is None:
+            return None
+        max_len = self.get("maximumPageLength")
+        min_len = self.get("minimumPageLength")
+        pattern = self.get("boundaryRegex")
+        # words carry their trailing boundary char
+        pieces = re.split(f"({pattern})", text)
+        words: List[str] = []
+        for i in range(0, len(pieces), 2):
+            w = pieces[i]
+            if i + 1 < len(pieces):
+                w += pieces[i + 1]
+            if w:
+                words.append(w)
+        pages, cur = [], ""
+        for w in words:
+            if len(cur) + len(w) <= max_len:
+                cur += w
+                if len(cur) >= min_len:
+                    pages.append(cur)
+                    cur = ""
+            else:
+                # fill the current page then hard-split the long word
+                take = max_len - len(cur)
+                cur += w[:take]
+                pages.append(cur)
+                rest = w[take:]
+                while len(rest) > max_len:
+                    pages.append(rest[:max_len])
+                    rest = rest[max_len:]
+                cur = rest
+        if cur:
+            pages.append(cur)
+        return pages
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        col = dataset.col(self.get("inputCol"))
+        out = np.empty(len(col), dtype=object)
+        for i, v in enumerate(col):
+            out[i] = self._split(v)
+        return dataset.with_column(self.get("outputCol"), out)

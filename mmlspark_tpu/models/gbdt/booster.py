@@ -53,6 +53,25 @@ class BoosterArrays:
     def num_trees(self) -> int:
         return self.split_feature.shape[0]
 
+    def _jitted(self, name: str, maker):
+        """Per-instance cache of jitted scorers — transform is called in
+        loops (per minibatch / per partition analog) and must not pay XLA
+        recompilation every call."""
+        cache = self.__dict__.setdefault("_fn_cache", {})
+        if name not in cache:
+            import jax
+            cache[name] = jax.jit(maker())
+        return cache[name]
+
+    def predict_jit(self):
+        return self._jitted("predict", self.predict_fn)
+
+    def leaf_index_jit(self):
+        return self._jitted("leaves", self.leaf_index_fn)
+
+    def contrib_jit(self):
+        return self._jitted("contrib", self.contrib_fn)
+
     @property
     def num_nodes(self) -> int:
         return self.split_feature.shape[1]

@@ -177,11 +177,22 @@ class _LightGBMBase(Estimator, _LightGBMParams):
         return df, None
 
     def _fit_booster(self, df: DataFrame, objective: str, num_class: int = 1,
-                     group_ids: Optional[np.ndarray] = None,
+                     group_col: Optional[str] = None,
                      extra_cfg: Optional[Dict[str, Any]] = None):
         measures = InstrumentationMeasures()
         train_df, valid_df = self._split_validation(df)
         x, y, w = self._extract(train_df)
+        # group ids must be computed on the *post-split* rows so they
+        # stay aligned with binned/y when a validation indicator is set
+        group_ids = vgroup_ids = None
+        if group_col is not None:
+            def encode_groups(frame):
+                raw = np.asarray(frame.col(group_col))
+                _, inv = np.unique(raw, return_inverse=True)
+                return inv.astype(np.int32)
+            group_ids = encode_groups(train_df)
+            if valid_df is not None and valid_df.num_rows:
+                vgroup_ids = encode_groups(valid_df)
         with measures.phase("binning"):
             cat = self.get("categoricalSlotIndexes") or []
             mapper = BinMapper.fit(
@@ -191,7 +202,7 @@ class _LightGBMBase(Estimator, _LightGBMParams):
         valid_sets = None
         if valid_df is not None and valid_df.num_rows:
             vx, vy, vw = self._extract(valid_df)
-            valid_sets = [(mapper.transform(vx), vy, vw)]
+            valid_sets = [(mapper.transform(vx), vy, vw, vgroup_ids)]
         cfg = self._train_config(objective, num_class=num_class,
                                  **(extra_cfg or {}))
         init_model = None
@@ -201,9 +212,8 @@ class _LightGBMBase(Estimator, _LightGBMParams):
         def init_scores(model, xs):
             # raw-space warm-start scores: computed on raw features so a
             # continued model is valid even under a different binning
-            import jax
             return None if model is None else np.asarray(
-                jax.jit(model.predict_fn())(xs))
+                model.predict_jit()(xs))
 
         vx_raw = None
         if valid_sets is not None:
@@ -291,13 +301,12 @@ class _LightGBMModelBase(Model, _LightGBMParams):
         return x
 
     def _maybe_extra_cols(self, df: DataFrame, x: np.ndarray) -> DataFrame:
-        import jax
         if self.is_set("leafPredictionCol"):
-            leaves = np.asarray(jax.jit(self.booster.leaf_index_fn())(x))
+            leaves = np.asarray(self.booster.leaf_index_jit()(x))
             df = df.with_column(self.get("leafPredictionCol"),
                                 leaves.astype(np.float64))
         if self.is_set("featuresShapCol"):
-            contribs = np.asarray(jax.jit(self.booster.contrib_fn())(x))
+            contribs = np.asarray(self.booster.contrib_jit()(x))
             df = df.with_column(self.get("featuresShapCol"),
                                 contribs.astype(np.float64))
         return df
@@ -382,11 +391,10 @@ class LightGBMClassificationModel(_LightGBMModelBase):
         self.classes_ = None if c is None else np.asarray(c)
 
     def _transform(self, df: DataFrame) -> DataFrame:
-        import jax
         import jax.numpy as jnp
 
         x = self._features(df)
-        raw = np.asarray(jax.jit(self.booster.predict_fn())(x))
+        raw = np.asarray(self.booster.predict_jit()(x))
         if raw.ndim == 1:  # binary: margins for [neg, pos]
             raw2 = np.stack([-raw, raw], axis=1)
             prob = 1.0 / (1.0 + np.exp(-raw))
@@ -442,10 +450,8 @@ class LightGBMRegressor(_LightGBMBase):
 
 class LightGBMRegressionModel(_LightGBMModelBase):
     def _transform(self, df: DataFrame) -> DataFrame:
-        import jax
-
         x = self._features(df)
-        raw = np.asarray(jax.jit(self.booster.predict_fn())(x))
+        raw = np.asarray(self.booster.predict_jit()(x))
         if self.booster.objective in ("poisson", "gamma", "tweedie"):
             raw = np.exp(raw)
         out = df.with_column(self.get("predictionCol"), raw.astype(np.float64))
@@ -467,10 +473,10 @@ class LightGBMRanker(_LightGBMBase):
                    default=[1, 3, 5])
 
     def _fit(self, df: DataFrame) -> "LightGBMRankerModel":
-        groups_raw = np.asarray(df.col(self.get("groupCol")))
-        _, group_ids = np.unique(groups_raw, return_inverse=True)
+        eval_at = self.get("evalAt") or [5]
         result, mapper, measures = self._fit_booster(
-            df, "lambdarank", group_ids=group_ids.astype(np.int32))
+            df, "lambdarank", group_col=self.get("groupCol"),
+            extra_cfg={"eval_at": int(eval_at[0])})
         model = LightGBMRankerModel(
             **{k: v for k, v in self._paramMap.items()
                if LightGBMRankerModel.has_param(k)})
@@ -483,10 +489,8 @@ class LightGBMRanker(_LightGBMBase):
 
 class LightGBMRankerModel(_LightGBMModelBase):
     def _transform(self, df: DataFrame) -> DataFrame:
-        import jax
-
         x = self._features(df)
-        raw = np.asarray(jax.jit(self.booster.predict_fn())(x))
+        raw = np.asarray(self.booster.predict_jit()(x))
         out = df.with_column(self.get("predictionCol"), raw.astype(np.float64))
         return self._maybe_extra_cols(out, x)
 

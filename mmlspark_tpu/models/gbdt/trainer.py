@@ -75,6 +75,7 @@ class TrainConfig:
     fair_c: float = 1.0
     early_stopping_round: int = 0
     metric: Optional[str] = None
+    eval_at: int = 5              # NDCG@k position (first evalAt entry)
     seed: int = 0
     deterministic: bool = True
     boost_from_average: bool = True
@@ -349,7 +350,9 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
         raw = jnp.full(raw_shape, base_score, dtype=jnp.float32)
 
     valid_states = []
-    for vi, (vb, vy, vw) in enumerate(valid_sets or []):
+    for vi, vset in enumerate(valid_sets or []):
+        vb, vy, vw = vset[:3]
+        vgroup = vset[3] if len(vset) > 3 else None
         if init_model is not None and valid_init_raws is not None:
             vraw = jnp.asarray(np.asarray(
                 valid_init_raws[vi], dtype=np.float32).reshape(
@@ -362,10 +365,18 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
             "labels": jnp.asarray(vy, dtype=jnp.float32),
             "weights": None if vw is None else jnp.asarray(vw, dtype=jnp.float32),
             "raw": vraw,
+            "group_ids": None if vgroup is None else jnp.asarray(vgroup),
         })
 
     metric_name = cfg.metric or metrics_mod.default_metric(cfg.objective)
-    metric_fn, higher_better = metrics_mod.METRICS[metric_name]
+    if metric_name == "ndcg" and cfg.eval_at != 5:
+        metric_fn, higher_better = metrics_mod.ndcg_at(cfg.eval_at), True
+    else:
+        metric_fn, higher_better = metrics_mod.METRICS[metric_name]
+    # evaluate with the same objective params we train with
+    # (TrainUtils.scala evals via the booster's own config): quantile's
+    # pinball alpha must match cfg.alpha, not the metric default
+    metric_kwargs = {"alpha": cfg.alpha} if metric_name == "quantile" else {}
 
     rng = np.random.default_rng(cfg.seed)
     trees_sf, trees_tb, trees_nv, trees_cnt = [], [], [], []
@@ -487,14 +498,21 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
         # ----- eval + early stopping -------------------------------------
         with measures.phase("validation"):
             record: Dict[str, float] = {"iteration": it}
-            mkw = {}
+            mkw = dict(metric_kwargs)
             if metric_name == "ndcg" and group_ids is not None:
                 mkw["group_ids"] = jnp.asarray(group_ids)
             record[f"train_{metric_name}"] = float(
                 metric_fn(raw, labels_d, weights_d, **mkw))
             for vi, vs in enumerate(valid_states):
+                vkw = dict(metric_kwargs)
+                if metric_name == "ndcg":
+                    if vs["group_ids"] is None:
+                        raise ValueError(
+                            f"valid set {vi}: ndcg eval requires its own "
+                            f"group ids (pass 4-tuples in valid_sets)")
+                    vkw["group_ids"] = vs["group_ids"]
                 record[f"valid{vi}_{metric_name}"] = float(
-                    metric_fn(vs["raw"], vs["labels"], vs["weights"], **mkw))
+                    metric_fn(vs["raw"], vs["labels"], vs["weights"], **vkw))
             evals.append(record)
         for cb in (callbacks or []):
             cb(it, record)

@@ -1,0 +1,25 @@
+"""Generic pipeline stages (parity: reference core `stages` package)."""
+
+from mmlspark_tpu.stages.basic import (Cacher, DropColumns, Explode, Lambda,
+                                       MultiColumnAdapter, RenameColumn,
+                                       Repartition, SelectColumns,
+                                       UDFTransformer, UnicodeNormalize)
+from mmlspark_tpu.stages.balance import (ClassBalancer, ClassBalancerModel,
+                                         StratifiedRepartition)
+from mmlspark_tpu.stages.batching import (DynamicMiniBatchTransformer,
+                                          FixedMiniBatchTransformer,
+                                          FlattenBatch, PartitionConsolidator,
+                                          TimeIntervalMiniBatchTransformer)
+from mmlspark_tpu.stages.summarize import SummarizeData
+from mmlspark_tpu.stages.text import EnsembleByKey, TextPreprocessor
+from mmlspark_tpu.stages.timer import Timer, TimerModel
+
+__all__ = [
+    "Cacher", "ClassBalancer", "ClassBalancerModel", "DropColumns",
+    "DynamicMiniBatchTransformer", "EnsembleByKey", "Explode",
+    "FixedMiniBatchTransformer", "FlattenBatch", "Lambda",
+    "MultiColumnAdapter", "PartitionConsolidator", "RenameColumn",
+    "Repartition", "SelectColumns", "StratifiedRepartition", "SummarizeData",
+    "TextPreprocessor", "TimeIntervalMiniBatchTransformer", "Timer",
+    "TimerModel", "UDFTransformer", "UnicodeNormalize",
+]

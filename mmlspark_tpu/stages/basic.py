@@ -1,0 +1,200 @@
+"""Generic column/row DataFrame transformers.
+
+Parity targets: the reference's ``stages`` package of ~20 small
+transformers (SURVEY.md §2.1): DropColumns.scala:1, SelectColumns.scala:1,
+RenameColumn.scala:1, Cacher.scala:1, Repartition.scala:1, Explode.scala:1,
+Lambda.scala:1, UDFTransformer.scala:1, MultiColumnAdapter.scala:1,
+UnicodeNormalize.scala:1. On the TPU-native columnar DataFrame most of
+these are thin; "partitions" map to device shards (a shard-count hint
+consumed by ``DataFrame.to_device``), not physical RDD partitions.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (HasInputCol, HasOutputCol, Param,
+                                     ParamValidationError, gt, one_of,
+                                     to_bool, to_int, to_list, to_str)
+from mmlspark_tpu.core.pipeline import Transformer
+
+
+class DropColumns(Transformer):
+    """Drops the listed columns (stages/DropColumns.scala:1)."""
+
+    cols = Param("cols", "columns to drop", to_list(to_str))
+
+    def __init__(self, cols: Optional[Sequence[str]] = None, **kwargs: Any):
+        super().__init__(**({"cols": list(cols)} if cols else {}), **kwargs)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        cols = self.get("cols") or []
+        missing = [c for c in cols if c not in dataset]
+        if missing:
+            raise KeyError(f"DropColumns: no such columns {missing}")
+        return dataset.drop(*cols)
+
+
+class SelectColumns(Transformer):
+    """Keeps only the listed columns (stages/SelectColumns.scala:1)."""
+
+    cols = Param("cols", "columns to keep", to_list(to_str))
+
+    def __init__(self, cols: Optional[Sequence[str]] = None, **kwargs: Any):
+        super().__init__(**({"cols": list(cols)} if cols else {}), **kwargs)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        return dataset.select(*(self.get("cols") or []))
+
+
+class RenameColumn(Transformer, HasInputCol, HasOutputCol):
+    """Renames inputCol to outputCol (stages/RenameColumn.scala:1)."""
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        return dataset.rename({self.get("inputCol"): self.get("outputCol")})
+
+
+class Cacher(Transformer):
+    """Materializes the dataset. The columnar DataFrame is already eager,
+    so this pins device copies of numeric columns when requested
+    (stages/Cacher.scala:1; `disable` param kept for parity)."""
+
+    disable = Param("disable", "whether to disable caching", to_bool,
+                    default=False)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        return dataset
+
+
+class Repartition(Transformer):
+    """Records a target shard count consumed by the device path; with
+    ``disable=False`` and n > 0 also re-spreads rows round-robin so any
+    contiguous device sharding sees an even row mix
+    (stages/Repartition.scala:1)."""
+
+    n = Param("n", "number of shards", to_int, gt(0))
+    disable = Param("disable", "do nothing if true", to_bool, default=False)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        if self.get("disable") or not self.is_set("n"):
+            return dataset
+        n = self.get("n")
+        num = dataset.num_rows
+        # round-robin order: row i goes to shard i % n, shards contiguous
+        order = np.argsort(np.arange(num) % n, kind="stable")
+        out = dataset.take_rows(order)
+        return out.with_metadata("__shards__", {"n": n})
+
+
+class Explode(Transformer, HasInputCol, HasOutputCol):
+    """Explodes a list/array column into one row per element
+    (stages/Explode.scala:1)."""
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        in_col, out_col = self.get("inputCol"), self.get("outputCol")
+        col = dataset.col(in_col)
+        lens = np.array([len(v) for v in col], dtype=np.int64)
+        row_idx = np.repeat(np.arange(dataset.num_rows), lens)
+        flat = [x for v in col for x in v]
+        exploded = dataset.take_rows(row_idx)
+        return exploded.with_column(out_col, flat)
+
+
+class Lambda(Transformer):
+    """Applies an arbitrary DataFrame -> DataFrame function
+    (stages/Lambda.scala:1)."""
+
+    transformFunc = Param("transformFunc", "df -> df function", is_complex=True)
+
+    def __init__(self, transformFunc: Optional[Callable[[DataFrame], DataFrame]] = None,
+                 **kwargs: Any):
+        super().__init__(**kwargs)
+        if transformFunc is not None:
+            self._paramMap["transformFunc"] = transformFunc
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        fn = self.get("transformFunc")
+        if fn is None:
+            raise ParamValidationError("Lambda requires transformFunc")
+        return fn(dataset)
+
+
+class UDFTransformer(Transformer, HasInputCol, HasOutputCol):
+    """Applies a per-row (or vectorized) function to one or more columns
+    (stages/UDFTransformer.scala:1). ``udf`` receives one value per input
+    column; if ``vectorized`` it receives whole column arrays instead —
+    the TPU-friendly path (wrap a jitted function)."""
+
+    inputCols = Param("inputCols", "multiple input columns", to_list(to_str))
+    udf = Param("udf", "the function to apply", is_complex=True)
+    vectorized = Param("vectorized", "call udf on whole columns", to_bool,
+                       default=False)
+
+    def __init__(self, udf: Optional[Callable] = None, **kwargs: Any):
+        super().__init__(**kwargs)
+        if udf is not None:
+            self._paramMap["udf"] = udf
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        fn = self.get("udf")
+        if fn is None:
+            raise ParamValidationError("UDFTransformer requires udf")
+        if self.is_set("inputCols"):
+            cols = [dataset.col(c) for c in self.get("inputCols")]
+        else:
+            cols = [dataset.col(self.get("inputCol"))]
+        if self.get("vectorized"):
+            result = fn(*cols)
+        else:
+            result = [fn(*vals) for vals in zip(*cols)]
+        return dataset.with_column(self.get("outputCol"), np.asarray(result))
+
+
+class MultiColumnAdapter(Transformer):
+    """Applies a single-column stage to several columns
+    (stages/MultiColumnAdapter.scala:1). The base stage must have
+    inputCol/outputCol params."""
+
+    inputCols = Param("inputCols", "input columns", to_list(to_str))
+    outputCols = Param("outputCols", "output columns", to_list(to_str))
+    baseStage = Param("baseStage", "stage to replicate per column",
+                      is_complex=True)
+
+    def __init__(self, baseStage=None, **kwargs: Any):
+        super().__init__(**kwargs)
+        if baseStage is not None:
+            self._paramMap["baseStage"] = baseStage
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        ins, outs = self.get("inputCols"), self.get("outputCols")
+        if not ins or not outs or len(ins) != len(outs):
+            raise ParamValidationError(
+                "MultiColumnAdapter needs equal-length inputCols/outputCols")
+        base = self.get("baseStage")
+        df = dataset
+        for i, o in zip(ins, outs):
+            stage = base.copy(inputCol=i, outputCol=o)
+            df = stage.transform(df)
+        return df
+
+
+class UnicodeNormalize(Transformer, HasInputCol, HasOutputCol):
+    """Unicode-normalizes a string column (stages/UnicodeNormalize.scala:1)."""
+
+    form = Param("form", "unicode normal form", to_str,
+                 one_of("NFC", "NFD", "NFKC", "NFKD"), default="NFKD")
+    lower = Param("lower", "lowercase the text", to_bool, default=True)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        form, lower = self.get("form"), self.get("lower")
+        col = dataset.col(self.get("inputCol"))
+        out = [None if v is None else
+               (unicodedata.normalize(form, v).lower() if lower
+                else unicodedata.normalize(form, v))
+               for v in col]
+        return dataset.with_column(self.get("outputCol"),
+                                   np.asarray(out, dtype=object))

@@ -1,0 +1,120 @@
+"""Mini-batching transformers.
+
+Parity: stages/MiniBatchTransformer.scala:153,189 (Fixed/Dynamic/
+TimeInterval mini-batchers + FlattenBatch) and
+stages/PartitionConsolidator.scala:22. Batched rows hold one array/list
+per cell — the shape the ONNX scorer and HTTP transformer consume — and
+``FlattenBatch`` undoes it. On TPU the fixed batcher is the important
+one: static batch sizes keep XLA shapes stable; the final ragged batch is
+either emitted short (host paths) or padded by the consumer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import Param, gt, to_bool, to_int
+from mmlspark_tpu.core.pipeline import Transformer
+
+
+def _batch_column(arr: np.ndarray, bounds: List[int]) -> np.ndarray:
+    """Slice a column into per-batch cells (object array of arrays)."""
+    out = np.empty(len(bounds) - 1, dtype=object)
+    for i in range(len(bounds) - 1):
+        out[i] = arr[bounds[i]:bounds[i + 1]]
+    return out
+
+
+def _batch_df(dataset: DataFrame, bounds: List[int]) -> DataFrame:
+    meta = {name: dataset.metadata(name) for name in dataset.columns
+            if dataset.metadata(name)}
+    return DataFrame({name: _batch_column(dataset.col(name), bounds)
+                      for name in dataset.columns}, meta)
+
+
+class FixedMiniBatchTransformer(Transformer):
+    """Groups rows into fixed-size batches
+    (stages/MiniBatchTransformer.scala:153). ``buffered`` and
+    ``maxBufferSize`` are accepted for parity; the columnar engine always
+    has the full column in host memory so buffering is moot."""
+
+    batchSize = Param("batchSize", "rows per batch", to_int, gt(0), default=16)
+    buffered = Param("buffered", "buffer batches (parity no-op)", to_bool,
+                     default=False)
+    maxBufferSize = Param("maxBufferSize", "max buffered batches", to_int,
+                          default=2147483647)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        bs = self.get("batchSize")
+        n = dataset.num_rows
+        bounds = list(range(0, n, bs)) + [n]
+        return _batch_df(dataset, bounds)
+
+
+class DynamicMiniBatchTransformer(Transformer):
+    """Batches all currently-available rows up to maxBatchSize
+    (stages/MiniBatchTransformer.scala:189). Eager-columnar semantics:
+    one batch of everything, capped."""
+
+    maxBatchSize = Param("maxBatchSize", "max rows per batch", to_int, gt(0),
+                         default=2147483647)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        cap = self.get("maxBatchSize")
+        n = dataset.num_rows
+        bounds = list(range(0, n, cap)) + [n]
+        bounds = sorted(set(bounds))
+        return _batch_df(dataset, bounds)
+
+
+class TimeIntervalMiniBatchTransformer(Transformer):
+    """Parity stub for the streaming time-interval batcher
+    (stages/MiniBatchTransformer.scala): on a bounded columnar dataset it
+    degenerates to maxBatchSize batching."""
+
+    millisToWait = Param("millisToWait", "interval between batches", to_int,
+                         gt(0), default=1000)
+    maxBatchSize = Param("maxBatchSize", "max rows per batch", to_int,
+                         default=2147483647)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        return DynamicMiniBatchTransformer(
+            maxBatchSize=self.get("maxBatchSize")).transform(dataset)
+
+
+class FlattenBatch(Transformer):
+    """Explodes batched rows back into single rows
+    (stages/MiniBatchTransformer.scala:189 FlattenBatch)."""
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        if dataset.num_rows == 0:
+            return dataset
+        names = dataset.columns
+        cols: dict = {}
+        for name in names:
+            cells = dataset.col(name)
+            parts = [np.asarray(c) for c in cells]
+            if parts and all(p.dtype != object for p in parts):
+                cols[name] = np.concatenate(parts)
+            else:
+                cols[name] = np.asarray(
+                    [x for c in cells for x in c], dtype=object)
+        lengths = {name: len(v) for name, v in cols.items()}
+        if len(set(lengths.values())) > 1:
+            raise ValueError(f"ragged batch columns: {lengths}")
+        meta = {name: dataset.metadata(name) for name in names
+                if dataset.metadata(name)}
+        return DataFrame(cols, meta)
+
+
+class PartitionConsolidator(Transformer):
+    """Funnels data to fewer shards (stages/PartitionConsolidator.scala:22).
+    Reference semantics: move all rows onto as few executors as have data,
+    for resource-constrained stages (one HTTP client per node). Columnar
+    analog: collapse the shard hint to 1."""
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        return dataset.with_metadata("__shards__", {"n": 1})

@@ -1,0 +1,107 @@
+"""SummarizeData: per-column summary statistics table.
+
+Parity: stages/SummarizeData.scala — feature column plus count / basic /
+sample / percentile stat groups, toggled by boolean params. Quantiles are
+exact (`errorThreshold` kept for parity; numpy quantiles are already
+exact on host columns).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import Param, to_bool, to_float
+from mmlspark_tpu.core.pipeline import Transformer
+
+
+class SummarizeData(Transformer):
+    counts = Param("counts", "compute count statistics", to_bool, default=True)
+    basic = Param("basic", "compute basic statistics", to_bool, default=True)
+    sample = Param("sample", "compute sample statistics", to_bool, default=True)
+    percentiles = Param("percentiles", "compute percentiles", to_bool,
+                        default=True)
+    errorThreshold = Param("errorThreshold",
+                           "quantile error threshold - 0 is exact", to_float,
+                           default=0.0)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        out: dict = {"Feature": []}
+        want_counts = self.get("counts")
+        want_basic = self.get("basic")
+        want_sample = self.get("sample")
+        want_pct = self.get("percentiles")
+        if want_counts:
+            out.update({"Count": [], "Unique Value Count": [],
+                        "Missing Value Count": []})
+        if want_basic:
+            out.update({"Min": [], "1st Quartile": [], "Median": [],
+                        "3rd Quartile": [], "Max": [], "Mean": [],
+                        "Range": []})
+        if want_sample:
+            out.update({"Sample Variance": [], "Sample Standard Deviation": [],
+                        "Sample Skewness": [], "Sample Kurtosis": []})
+        if want_pct:
+            out.update({f"P{p}": [] for p in (0.5, 1, 5, 30, 70, 95, 99, 99.5)})
+
+        for name in dataset.columns:
+            arr = dataset.col(name)
+            if arr.ndim != 1:
+                continue
+            out["Feature"].append(name)
+            is_numeric = np.issubdtype(arr.dtype, np.number) or arr.dtype == bool
+            numeric = arr.astype(np.float64) if is_numeric else None
+            valid = numeric[~np.isnan(numeric)] if is_numeric else None
+
+            if want_counts:
+                out["Count"].append(float(len(arr)))
+                if is_numeric:
+                    out["Unique Value Count"].append(float(len(np.unique(valid))))
+                    out["Missing Value Count"].append(float(np.isnan(numeric).sum()))
+                else:
+                    vals = [v for v in arr if v is not None]
+                    out["Unique Value Count"].append(float(len(set(vals))))
+                    out["Missing Value Count"].append(float(len(arr) - len(vals)))
+
+            nan = float("nan")
+            if want_basic:
+                if is_numeric and len(valid):
+                    q1, med, q3 = np.quantile(valid, [0.25, 0.5, 0.75])
+                    out["Min"].append(float(valid.min()))
+                    out["1st Quartile"].append(float(q1))
+                    out["Median"].append(float(med))
+                    out["3rd Quartile"].append(float(q3))
+                    out["Max"].append(float(valid.max()))
+                    out["Mean"].append(float(valid.mean()))
+                    out["Range"].append(float(valid.max() - valid.min()))
+                else:
+                    for k in ("Min", "1st Quartile", "Median", "3rd Quartile",
+                              "Max", "Mean", "Range"):
+                        out[k].append(nan)
+            if want_sample:
+                if is_numeric and len(valid) > 1:
+                    var = float(valid.var(ddof=1))
+                    sd = float(np.sqrt(var))
+                    centered = valid - valid.mean()
+                    m2 = float((centered ** 2).mean())
+                    skew = (float((centered ** 3).mean()) / m2 ** 1.5
+                            if m2 > 0 else nan)
+                    kurt = (float((centered ** 4).mean()) / m2 ** 2 - 3.0
+                            if m2 > 0 else nan)
+                    out["Sample Variance"].append(var)
+                    out["Sample Standard Deviation"].append(sd)
+                    out["Sample Skewness"].append(skew)
+                    out["Sample Kurtosis"].append(kurt)
+                else:
+                    for k in ("Sample Variance", "Sample Standard Deviation",
+                              "Sample Skewness", "Sample Kurtosis"):
+                        out[k].append(nan)
+            if want_pct:
+                for p in (0.5, 1, 5, 30, 70, 95, 99, 99.5):
+                    if is_numeric and len(valid):
+                        out[f"P{p}"].append(float(np.quantile(valid, p / 100)))
+                    else:
+                        out[f"P{p}"].append(nan)
+
+        return DataFrame({k: np.asarray(v, dtype=object) if k == "Feature"
+                          else np.asarray(v) for k, v in out.items()})

@@ -1,0 +1,165 @@
+"""Text preprocessing stages.
+
+Parity: stages/TextPreprocessor.scala (trie-backed longest-match,
+left-to-right substring replacement with a normalization function) and
+stages/EnsembleByKey.scala (grouped vector/scalar aggregation).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (HasInputCol, HasOutputCol, Param,
+                                     ParamValidationError, one_of, to_bool,
+                                     to_list, to_str)
+from mmlspark_tpu.core.pipeline import Transformer
+
+_NORM_FUNCS = {
+    "identity": lambda c: c,
+    "lowerCase": str.lower,
+    "upperCase": str.upper,
+}
+
+
+class _Trie:
+    """Character trie with longest-match scan, mirroring the matching
+    semantics of TextPreprocessor.scala:18-88: longest key wins, matches
+    scanned left to right, and after a replacement any immediately
+    following word characters are skipped."""
+
+    __slots__ = ("children", "value")
+
+    def __init__(self):
+        self.children: Dict[str, "_Trie"] = {}
+        self.value: Optional[str] = None
+
+    def put(self, key: str, value: str, norm) -> None:
+        node = self
+        for ch in key:
+            ch = norm(ch)
+            node = node.children.setdefault(ch, _Trie())
+        node.value = value
+
+    def map_text(self, text: str, norm) -> str:
+        out = []
+        i, n = 0, len(text)
+        while i < n:
+            node, j = self, i
+            best_end, best_val = -1, None
+            while j < n:
+                child = node.children.get(norm(text[j]))
+                if child is None:
+                    break
+                node, j = child, j + 1
+                if node.value is not None:
+                    best_end, best_val = j, node.value
+            if best_val is not None:
+                out.append(best_val)
+                i = best_end
+                while i < n and (text[i].isalnum() or text[i] == "_"):
+                    i += 1  # skip trailing word chars after a match
+            else:
+                out.append(text[i])
+                i += 1
+        return "".join(out)
+
+
+class TextPreprocessor(Transformer, HasInputCol, HasOutputCol):
+    """Replaces substrings per a map, longest match first
+    (stages/TextPreprocessor.scala:96-)."""
+
+    map = Param("map", "substring -> replacement map", is_complex=True)
+    normFunc = Param("normFunc", "identity | lowerCase | upperCase", to_str,
+                     one_of(*_NORM_FUNCS), default="identity")
+
+    def __init__(self, map: Optional[Dict[str, str]] = None, **kwargs: Any):
+        super().__init__(**kwargs)
+        if map is not None:
+            self._paramMap["map"] = dict(map)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        mapping = self.get("map") or {}
+        norm = _NORM_FUNCS[self.get("normFunc")]
+        trie = _Trie()
+        for k, v in mapping.items():
+            trie.put(k, v, norm)
+        col = dataset.col(self.get("inputCol"))
+        out = [None if v is None else trie.map_text(v, norm) for v in col]
+        return dataset.with_column(self.get("outputCol"),
+                                   np.asarray(out, dtype=object))
+
+
+class EnsembleByKey(Transformer):
+    """Aggregates scalar/vector columns grouped by key columns
+    (stages/EnsembleByKey.scala:1). ``strategy`` is mean (the only
+    reference strategy); ``collapseGroup`` controls one-row-per-key vs.
+    joining the aggregate back onto every row."""
+
+    keys = Param("keys", "grouping key columns", to_list(to_str))
+    cols = Param("cols", "columns to aggregate", to_list(to_str))
+    colNames = Param("colNames", "output column names", to_list(to_str))
+    strategy = Param("strategy", "aggregation strategy", to_str,
+                     one_of("mean"), default="mean")
+    collapseGroup = Param("collapseGroup", "one row per key", to_bool,
+                          default=True)
+    vectorDims = Param("vectorDims", "expected vector dims (parity)",
+                       is_complex=True)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        keys = self.get("keys") or []
+        cols = self.get("cols") or []
+        if not keys or not cols:
+            raise ParamValidationError("EnsembleByKey requires keys and cols")
+        names = self.get("colNames") or [f"mean({c})" for c in cols]
+        if len(names) != len(cols):
+            raise ParamValidationError("colNames must match cols")
+
+        # build a composite group key
+        if len(keys) == 1:
+            group_map = dataset.group_indices(keys[0])
+        else:
+            composite = np.asarray(
+                [tuple(dataset.col(k)[i] for k in keys)
+                 for i in range(dataset.num_rows)], dtype=object)
+            tmp = dataset.with_column("__gkey__", composite)
+            group_map = tmp.group_indices("__gkey__")
+
+        group_keys = list(group_map.keys())
+        agg: Dict[str, list] = {n: [] for n in names}
+        for gk in group_keys:
+            idx = group_map[gk]
+            for c, n in zip(cols, names):
+                arr = dataset.col(c)
+                agg[n].append(np.asarray(arr[idx]).mean(axis=0))
+
+        if self.get("collapseGroup"):
+            out_cols: Dict[str, Any] = {}
+            for j, k in enumerate(keys):
+                if len(keys) == 1:
+                    out_cols[k] = np.asarray(group_keys)
+                else:
+                    out_cols[k] = np.asarray([gk[j] for gk in group_keys])
+            for n in names:
+                vals = agg[n]
+                out_cols[n] = (np.stack(vals)
+                               if np.asarray(vals[0]).ndim else np.asarray(vals))
+            key_meta = {k: dataset.metadata(k) for k in keys
+                        if dataset.metadata(k)}
+            return DataFrame(out_cols, key_meta)
+
+        index_of = {gk: i for i, gk in enumerate(group_keys)}
+        if len(keys) == 1:
+            row_groups = [index_of[v] for v in dataset.col(keys[0]).tolist()]
+        else:
+            row_groups = [index_of[tuple(dataset.col(k)[i] for k in keys)]
+                          for i in range(dataset.num_rows)]
+        df = dataset
+        for n in names:
+            vals = agg[n]
+            stacked = (np.stack(vals)
+                       if np.asarray(vals[0]).ndim else np.asarray(vals))
+            df = df.with_column(n, stacked[np.asarray(row_groups)])
+        return df

@@ -1,0 +1,80 @@
+"""Timer stage: wraps another stage and records wall-clock timing.
+
+Parity: stages/Timer.scala — an Estimator whose fit times the inner
+stage's fit (and optionally its transform), logging through the
+framework's structured telemetry (core/timer.py StopWatch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.logging_utils import logger
+from mmlspark_tpu.core.param import Param, to_bool
+from mmlspark_tpu.core.pipeline import Estimator, Model, PipelineStage, Transformer
+from mmlspark_tpu.core.timer import StopWatch
+
+
+class Timer(Estimator):
+    stage = Param("stage", "the stage to time", is_complex=True)
+    logToScala = Param("logToScala", "log to framework logger (vs print)",
+                       to_bool, default=True)
+    disableMaterialization = Param(
+        "disableMaterialization",
+        "whether to skip materializing the output before stopping the clock",
+        to_bool, default=True)
+
+    def __init__(self, stage: Optional[PipelineStage] = None, **kwargs: Any):
+        super().__init__(**kwargs)
+        if stage is not None:
+            self._paramMap["stage"] = stage
+
+    def _log(self, message: str) -> None:
+        if self.get("logToScala"):
+            logger.info(message)
+        else:
+            print(message)
+
+    def _fit(self, dataset: DataFrame) -> "TimerModel":
+        inner = self.get("stage")
+        watch = StopWatch()
+        if isinstance(inner, Estimator):
+            with watch.measure():
+                fitted = inner.fit(dataset)
+            self._log(f"{type(inner).__name__}.fit took {watch.elapsed:.4f}s")
+        else:
+            fitted = inner
+        model = TimerModel(stage=self)
+        model.fitted_stage = fitted
+        return model
+
+
+class TimerModel(Model):
+    stage = Param("stage", "the owning Timer", is_complex=True)
+
+    fitted_stage: Transformer
+
+    def __init__(self, stage: Optional[Timer] = None, **kwargs: Any):
+        super().__init__(**kwargs)
+        if stage is not None:
+            self._paramMap["stage"] = stage
+
+    def _get_state(self):
+        return {"fitted_stage": self.fitted_stage}
+
+    def _set_state(self, state):
+        self.fitted_stage = state["fitted_stage"]
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        timer: Timer = self.get("stage")
+        watch = StopWatch()
+        with watch.measure():
+            out = self.fitted_stage.transform(dataset)
+        msg = (f"{type(self.fitted_stage).__name__}.transform took "
+               f"{watch.elapsed:.4f}s")
+        if timer is not None:
+            timer._log(msg)
+        else:
+            logger.info(msg)
+        return out

@@ -1,0 +1,139 @@
+"""Featurize module tests (parity: VerifyCleanMissingData,
+VerifyValueIndexer, VerifyTextFeaturizer, VerifyFeaturize suites)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.featurize import (CleanMissingData, CountSelector,
+                                    DataConversion, Featurize, IndexToValue,
+                                    MultiNGram, PageSplitter, TextFeaturizer,
+                                    ValueIndexer, VectorAssembler)
+
+
+def test_clean_missing_mean_median_custom():
+    df = DataFrame({"a": np.array([1.0, np.nan, 3.0]),
+                    "b": np.array([np.nan, 4.0, 8.0])})
+    m = CleanMissingData(inputCols=["a", "b"], outputCols=["a", "b"]).fit(df)
+    out = m.transform(df)
+    np.testing.assert_allclose(out.col("a"), [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(out.col("b"), [6.0, 4.0, 8.0])
+
+    m = CleanMissingData(inputCols=["a"], outputCols=["a"],
+                         cleaningMode="Median").fit(df)
+    np.testing.assert_allclose(m.transform(df).col("a"), [1.0, 2.0, 3.0])
+
+    m = CleanMissingData(inputCols=["a"], outputCols=["a"],
+                         cleaningMode="Custom", customValue=-1.0).fit(df)
+    np.testing.assert_allclose(m.transform(df).col("a"), [1.0, -1.0, 3.0])
+
+
+def test_value_indexer_roundtrip():
+    df = DataFrame({"c": ["b", "a", "b", None]})
+    model = ValueIndexer(inputCol="c", outputCol="i").fit(df)
+    out = model.transform(df)
+    # levels sorted ascending, null last (ValueIndexer.scala NullOrdering)
+    assert model.levels == ["a", "b", None]
+    np.testing.assert_array_equal(out.col("i"), [1, 0, 1, 2])
+    back = IndexToValue(inputCol="i", outputCol="c2").transform(out)
+    assert list(back.col("c2")) == ["b", "a", "b", None]
+    with pytest.raises(ValueError):
+        model.transform(DataFrame({"c": ["unseen"]}))
+
+
+def test_value_indexer_numeric():
+    df = DataFrame({"c": np.array([5, 3, 5, 9])})
+    model = ValueIndexer(inputCol="c", outputCol="i").fit(df)
+    assert model.levels == [3, 5, 9]
+    np.testing.assert_array_equal(model.transform(df).col("i"), [1, 0, 1, 2])
+
+
+def test_data_conversion():
+    df = DataFrame({"a": np.array([1.5, 2.5]), "s": ["1", "2"]})
+    out = DataConversion(cols=["a"], convertTo="integer").transform(df)
+    assert out.col("a").dtype == np.int32
+    out = DataConversion(cols=["s"], convertTo="double").transform(df)
+    np.testing.assert_allclose(out.col("s"), [1.0, 2.0])
+    out = DataConversion(cols=["a"], convertTo="string").transform(df)
+    assert list(out.col("a")) == ["1.5", "2.5"]
+    cat = DataConversion(cols=["s"], convertTo="toCategorical").transform(df)
+    assert cat.metadata("s")["categorical"]
+
+
+def test_count_selector():
+    df = DataFrame({"f": np.array([[1.0, 0.0, 2.0], [3.0, 0.0, 0.0]])})
+    model = CountSelector(inputCol="f", outputCol="o").fit(df)
+    assert model.indices == [0, 2]
+    out = model.transform(df)
+    assert out.col("o").shape == (2, 2)
+
+
+def test_vector_assembler():
+    df = DataFrame({"x": np.array([1.0, 2.0]),
+                    "v": np.array([[3.0, 4.0], [5.0, 6.0]])})
+    out = VectorAssembler(inputCols=["x", "v"], outputCol="f").transform(df)
+    np.testing.assert_allclose(out.col("f"), [[1, 3, 4], [2, 5, 6]])
+    assert out.metadata("f")["slots"] == ["x", "v_0", "v_1"]
+
+
+def test_text_featurizer_tf_idf():
+    df = DataFrame({"t": ["the cat sat", "the dog sat", "a bird flew"]})
+    model = TextFeaturizer(inputCol="t", outputCol="f", numFeatures=64,
+                           useIDF=True).fit(df)
+    out = model.transform(df)
+    assert out.col("f").shape == (3, 64)
+    # idf of a term in all docs < idf of a rarer term
+    assert out.col("f").sum() > 0
+
+    nostop = TextFeaturizer(inputCol="t", outputCol="f", numFeatures=64,
+                            useStopWordsRemover=True, useIDF=False).fit(df)
+    o2 = nostop.transform(df)
+    # "the"/"a" removed -> fewer nonzero counts
+    assert o2.col("f").sum() < out.col("f").astype(bool).sum() + 100
+
+
+def test_text_featurizer_ngrams():
+    df = DataFrame({"t": ["a b c d"]})
+    model = TextFeaturizer(inputCol="t", outputCol="f", numFeatures=32,
+                           useNGram=True, nGramLength=2, useIDF=False).fit(df)
+    out = model.transform(df)
+    assert out.col("f").sum() == 3  # "a b", "b c", "c d"
+
+
+def test_multi_ngram():
+    df = DataFrame({"toks": np.array([["a", "b", "c"]], dtype=object)})
+    out = MultiNGram(inputCol="toks", outputCol="ng",
+                     lengths=[1, 2, 3]).transform(df)
+    assert out.col("ng")[0] == ["a", "b", "c", "a b", "b c", "a b c"]
+
+
+def test_page_splitter():
+    text = "word " * 100  # 500 chars
+    df = DataFrame({"t": [text.strip(), None]})
+    out = PageSplitter(inputCol="t", outputCol="p", maximumPageLength=100,
+                       minimumPageLength=80).transform(df)
+    pages = out.col("p")[0]
+    assert all(len(p) <= 100 for p in pages)
+    assert "".join(pages) == text.strip()
+    assert out.col("p")[1] is None
+    # a word longer than a page gets hard-split
+    long_word = "x" * 250
+    out = PageSplitter(inputCol="t", outputCol="p", maximumPageLength=100,
+                       minimumPageLength=80).transform(
+        DataFrame({"t": [long_word]}))
+    assert "".join(out.col("p")[0]) == long_word
+
+
+def test_featurize_end_to_end():
+    df = DataFrame({
+        "num": np.array([1.0, np.nan, 3.0, 4.0]),
+        "cat": ["r", "g", "r", "b"],
+        "y": np.array([0, 1, 0, 1]),
+    })
+    model = Featurize(inputCols=["num", "cat"], outputCol="features").fit(df)
+    out = model.transform(df)
+    feats = out.col("features")
+    assert feats.shape[0] == 4
+    # 1 numeric + 3 one-hot slots
+    assert feats.shape[1] == 4
+    assert not np.isnan(feats).any()

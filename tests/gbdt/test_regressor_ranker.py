@@ -130,3 +130,46 @@ def test_lambdarank_beats_random():
     random_ndcg = ndcg_at_k(rng.normal(size=len(scores)), df["label"], groups)
     assert ndcg > 0.8, f"ndcg={ndcg}"
     assert ndcg > random_ndcg + 0.15
+
+
+def test_lambdarank_with_validation_split():
+    """Regression: group ids must be computed post-validation-split so the
+    lambdarank pair masks and the per-valid-set NDCG stay aligned."""
+    df = make_ranking()
+    # mark two whole queries as validation (groups must not straddle)
+    groups = df["query"]
+    is_val = np.isin(groups, [0, 1])
+    df = df.with_column("isVal", is_val)
+    ranker = LightGBMRanker(numIterations=8, numLeaves=7, maxDepth=3,
+                            minDataInLeaf=3, groupCol="query",
+                            validationIndicatorCol="isVal",
+                            earlyStoppingRound=5, evalAt=[3])
+    model = ranker.fit(df)
+    # eval record must contain a finite valid ndcg for every iteration run
+    assert model.evals_result
+    for rec in model.evals_result:
+        assert np.isfinite(rec["valid0_ndcg"])
+    scores = model.transform(df)["prediction"]
+    assert np.isfinite(scores).all()
+
+
+def test_quantile_metric_uses_cfg_alpha():
+    """Regression: quantile eval metric must use the trained alpha."""
+    from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
+    from mmlspark_tpu.ops.binning import BinMapper
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(300, 4))
+    y = x[:, 0] + rng.normal(size=300)
+    mapper = BinMapper.fit(x, max_bin=32)
+    binned = mapper.transform(x)
+    cfg = TrainConfig(objective="quantile", alpha=0.9, num_iterations=3,
+                      num_leaves=7, max_depth=3, min_data_in_leaf=5,
+                      max_bin=32)
+    res = train(binned, y, cfg, bin_upper=mapper.bin_upper_values(32))
+    import jax.numpy as jnp
+    from mmlspark_tpu.models.gbdt import metrics as M
+    raw = res.booster.predict_jit()(x)
+    expected = float(M.quantile_loss(jnp.asarray(raw), jnp.asarray(y),
+                                     alpha=0.9))
+    assert res.evals[-1]["train_quantile"] == pytest.approx(expected, rel=1e-4)
