@@ -1,0 +1,27 @@
+"""Hyperparameter search + best-model selection.
+
+Parity surface: reference ``automl`` package
+(automl/TuneHyperparameters.scala:38, FindBestModel.scala:53,
+HyperparamBuilder.scala:1, DefaultHyperparams.scala:1).
+"""
+
+from mmlspark_tpu.automl.hyperparams import (
+    DiscreteHyperParam,
+    GridSpace,
+    HyperparamBuilder,
+    RandomSpace,
+    RangeHyperParam,
+)
+from mmlspark_tpu.automl.search import (
+    BestModel,
+    FindBestModel,
+    TuneHyperparameters,
+    TuneHyperparametersModel,
+)
+
+__all__ = [
+    "HyperparamBuilder", "DiscreteHyperParam", "RangeHyperParam",
+    "GridSpace", "RandomSpace",
+    "TuneHyperparameters", "TuneHyperparametersModel",
+    "FindBestModel", "BestModel",
+]

@@ -1,0 +1,86 @@
+"""Hyperparameter space definitions.
+
+Parity: automl/HyperparamBuilder.scala:1 — ``HyperparamBuilder`` collects
+(param, distribution) pairs; ``GridSpace`` enumerates the cross product;
+``RandomSpace`` samples each param independently.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+
+class DiscreteHyperParam:
+    """A finite set of values, sampled uniformly (Dist in the reference)."""
+
+    def __init__(self, values: Sequence[Any], seed: int = 0):
+        self.values = list(values)
+        self._rng = np.random.default_rng(seed)
+
+    def get_next(self) -> Any:
+        return self.values[int(self._rng.integers(len(self.values)))]
+
+    def grid_values(self) -> List[Any]:
+        return list(self.values)
+
+
+class RangeHyperParam:
+    """Uniform value in [lo, hi); int or float by endpoint type."""
+
+    def __init__(self, lo, hi, seed: int = 0):
+        self.lo, self.hi = lo, hi
+        self.is_int = isinstance(lo, int) and isinstance(hi, int)
+        self._rng = np.random.default_rng(seed)
+
+    def get_next(self) -> Any:
+        if self.is_int:
+            return int(self._rng.integers(self.lo, self.hi))
+        return float(self._rng.uniform(self.lo, self.hi))
+
+    def grid_values(self, num: int = 5) -> List[Any]:
+        if self.is_int:
+            vals = np.unique(np.linspace(self.lo, self.hi - 1, num).astype(int))
+            return [int(v) for v in vals]
+        return [float(v) for v in np.linspace(self.lo, self.hi, num)]
+
+
+class HyperparamBuilder:
+    def __init__(self):
+        self._space: List[Tuple[str, Any]] = []
+
+    def add_hyperparam(self, name: str, dist) -> "HyperparamBuilder":
+        self._space.append((name, dist))
+        return self
+
+    def build(self) -> List[Tuple[str, Any]]:
+        return list(self._space)
+
+
+class GridSpace:
+    """Cross-product enumeration of discrete grids (GridSpace in the
+    reference builds ParamMap arrays the same way)."""
+
+    def __init__(self, space: Sequence[Tuple[str, Any]]):
+        self.space = list(space)
+
+    def param_maps(self) -> Iterator[Dict[str, Any]]:
+        names = [n for n, _ in self.space]
+        grids = [d.grid_values() for _, d in self.space]
+        for combo in itertools.product(*grids):
+            yield dict(zip(names, combo))
+
+
+class RandomSpace:
+    """Independent sampling per param (RandomSpace parity)."""
+
+    def __init__(self, space: Sequence[Tuple[str, Any]], seed: int = 0):
+        self.space = list(space)
+        for i, (_, d) in enumerate(self.space):
+            d._rng = np.random.default_rng(seed + i)
+
+    def param_maps(self) -> Iterator[Dict[str, Any]]:
+        while True:
+            yield {n: d.get_next() for n, d in self.space}

@@ -1,0 +1,205 @@
+"""TuneHyperparameters + FindBestModel.
+
+Parity: automl/TuneHyperparameters.scala:38 — k-fold cross-validated
+random search over one or more learners, thread-parallel trials
+(``parallelism`` param, same meaning as the reference's execution
+context, TuneHyperparameters.scala:101-130); automl/FindBestModel.scala:53
+— evaluate already-fitted models on a dataset and keep the best.
+
+TPU note: trials share the single device sequentially per thread —
+parallelism here overlaps host-side work (binning, featurize) with
+device compute; a vmapped multi-trial path is a later optimization.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import Param, gt, to_int, to_str
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.train.statistics import ComputeModelStatistics, MetricConstants
+from mmlspark_tpu.automl.hyperparams import RandomSpace
+
+_MINIMIZED = {MetricConstants.Mse, MetricConstants.Rmse, MetricConstants.Mae}
+
+
+def _evaluate(scored: DataFrame, metric: str, label_col: str,
+              prediction_col: str, scores_col: Optional[str]) -> float:
+    want = metric
+    if metric in (MetricConstants.AllSparkMetrics,):
+        want = "all"
+    cms = ComputeModelStatistics(labelCol=label_col,
+                                 scoredLabelsCol=prediction_col,
+                                 evaluationMetric=want
+                                 if want != "all" else "all",
+                                 scoresCol=scores_col)
+    row = cms.transform(scored)
+    if metric == "all":
+        # default: AUC for classification, r2 for regression
+        for name in (MetricConstants.Auc, MetricConstants.Accuracy,
+                     MetricConstants.R2):
+            if name in row:
+                return float(row.col(name)[0])
+        raise ValueError(f"no default metric in {row.columns}")
+    if metric not in row:
+        raise ValueError(f"metric {metric} not computed; have {row.columns}")
+    return float(row.col(metric)[0])
+
+
+def _higher_better(metric: str) -> bool:
+    return metric not in _MINIMIZED
+
+
+class TuneHyperparameters(Estimator):
+    """Random-search CV over estimators × param space."""
+
+    models = Param("models", "candidate estimators", is_complex=True)
+    paramSpace = Param("paramSpace", "list of (paramName, dist) pairs "
+                       "(HyperparamBuilder.build())", is_complex=True)
+    evaluationMetric = Param("evaluationMetric", "metric to optimize", to_str,
+                             default="all")
+    numFolds = Param("numFolds", "number of CV folds", to_int, gt(1), default=3)
+    numRuns = Param("numRuns", "number of sampled param maps", to_int, gt(0),
+                    default=8)
+    parallelism = Param("parallelism", "concurrent trials", to_int, gt(0),
+                        default=4)
+    seed = Param("seed", "random seed", to_int, default=0)
+    labelCol = Param("labelCol", "label column", to_str, default="label")
+
+    def _fit(self, dataset: DataFrame) -> "TuneHyperparametersModel":
+        estimators: List[Estimator] = list(self.get("models"))
+        space = self.get("paramSpace") or []
+        metric = self.get("evaluationMetric")
+        num_folds = self.get("numFolds")
+        seed = self.get("seed")
+        label_col = self.get("labelCol")
+
+        sampler = iter(RandomSpace(space, seed=seed).param_maps())
+        trials: List[Tuple[Estimator, Dict[str, Any]]] = []
+        for r in range(self.get("numRuns")):
+            params = next(sampler) if space else {}
+            est = estimators[r % len(estimators)]
+            applicable = {k: v for k, v in params.items() if est.has_param(k)}
+            trials.append((est.copy(**applicable), applicable))
+
+        # fold index assignment, deterministic
+        rng = np.random.default_rng(seed)
+        fold = rng.integers(0, num_folds, size=dataset.num_rows)
+
+        def run_trial(trial: Tuple[Estimator, Dict[str, Any]]) -> float:
+            est, _ = trial
+            scores = []
+            for f in range(num_folds):
+                train_df = dataset.filter(fold != f)
+                valid_df = dataset.filter(fold == f)
+                if train_df.num_rows == 0 or valid_df.num_rows == 0:
+                    continue
+                model = est.fit(train_df)
+                scored = model.transform(valid_df)
+                pred_col = model.get("predictionCol") \
+                    if model.has_param("predictionCol") else "prediction"
+                scores_col = None
+                for cand in ("probability", "rawPrediction", "score"):
+                    if cand in scored:
+                        scores_col = cand
+                        break
+                scores.append(_evaluate(scored, metric, label_col, pred_col,
+                                        scores_col))
+            return float(np.mean(scores)) if scores else float("-inf")
+
+        with ThreadPoolExecutor(max_workers=self.get("parallelism")) as pool:
+            results = list(pool.map(run_trial, trials))
+
+        sign = 1.0 if _higher_better(metric) else -1.0
+        best_i = int(np.argmax([sign * r for r in results]))
+        best_est, best_params = trials[best_i]
+        best_model = best_est.fit(dataset)
+        out = TuneHyperparametersModel()
+        out._set(bestModel=best_model, bestMetric=float(results[best_i]))
+        out.best_params = best_params
+        out.all_metrics = results
+        return out
+
+
+class TuneHyperparametersModel(Model):
+    bestModel = Param("bestModel", "best fitted model", is_complex=True)
+    bestMetric = Param("bestMetric", "metric of the best model", is_complex=True)
+
+    best_params: Dict[str, Any] = {}
+    all_metrics: List[float] = []
+
+    def get_best_model(self) -> Model:
+        return self.get("bestModel")
+
+    def get_best_metric(self) -> float:
+        return self.get("bestMetric")
+
+    def get_best_model_info(self) -> str:
+        return repr(self.get("bestModel"))
+
+    def _get_state(self):
+        return {"best_params": self.best_params, "all_metrics": self.all_metrics}
+
+    def _set_state(self, state):
+        self.best_params = state.get("best_params", {})
+        self.all_metrics = state.get("all_metrics", [])
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        return self.get("bestModel").transform(dataset)
+
+
+class FindBestModel(Estimator):
+    """Evaluate fitted transformers on a dataset; keep the best
+    (FindBestModel.scala:76-119)."""
+
+    models = Param("models", "fitted models to compare", is_complex=True)
+    evaluationMetric = Param("evaluationMetric", "metric", to_str, default="all")
+    labelCol = Param("labelCol", "label column", to_str, default="label")
+
+    def _fit(self, dataset: DataFrame) -> "BestModel":
+        metric = self.get("evaluationMetric")
+        label_col = self.get("labelCol")
+        rows = []
+        best, best_val, best_scored = None, None, None
+        sign = 1.0 if _higher_better(metric) else -1.0
+        for model in self.get("models"):
+            scored = model.transform(dataset)
+            pred_col = model.get("predictionCol") \
+                if model.has_param("predictionCol") else "prediction"
+            scores_col = next((c for c in ("probability", "rawPrediction",
+                                           "score") if c in scored), None)
+            val = _evaluate(scored, metric, label_col, pred_col, scores_col)
+            rows.append({"model": type(model).__name__, "uid": model.uid,
+                         "metric": val})
+            if best_val is None or sign * val > sign * best_val:
+                best, best_val, best_scored = model, val, scored
+        out = BestModel()
+        out._set(bestModel=best, bestModelMetrics=float(best_val))
+        out.all_model_metrics = DataFrame.from_rows(rows)
+        out.scored_dataset = best_scored
+        return out
+
+
+class BestModel(Model):
+    bestModel = Param("bestModel", "winning model", is_complex=True)
+    bestModelMetrics = Param("bestModelMetrics", "winning metric value",
+                             is_complex=True)
+
+    all_model_metrics: Optional[DataFrame] = None
+    scored_dataset: Optional[DataFrame] = None
+
+    def get_best_model(self) -> Transformer:
+        return self.get("bestModel")
+
+    def get_best_model_metrics(self) -> float:
+        return self.get("bestModelMetrics")
+
+    def get_all_model_metrics(self) -> Optional[DataFrame]:
+        return self.all_model_metrics
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        return self.get("bestModel").transform(dataset)

@@ -1,0 +1,34 @@
+"""Causal inference: Double ML, ortho-forest DML, diff-in-diff +
+synthetic control.
+
+Parity surface: reference ``causal`` package
+(causal/DoubleMLEstimator.scala:63, OrthoForestDMLEstimator.scala:1,
+DiffInDiffEstimator.scala, SyntheticControlEstimator.scala,
+SyntheticDiffInDiffEstimator.scala, causal/opt/MirrorDescent.scala:1,
+causal/linalg/*).
+"""
+
+from mmlspark_tpu.causal.diff_in_diff import (
+    DiffInDiffEstimator,
+    DiffInDiffModel,
+    SyntheticControlEstimator,
+    SyntheticDiffInDiffEstimator,
+)
+from mmlspark_tpu.causal.dml import (
+    DoubleMLEstimator,
+    DoubleMLModel,
+    ResidualTransformer,
+)
+from mmlspark_tpu.causal.opt import constrained_least_square, mirror_descent
+from mmlspark_tpu.causal.ortho_forest import (
+    OrthoForestDMLEstimator,
+    OrthoForestDMLModel,
+)
+
+__all__ = [
+    "DoubleMLEstimator", "DoubleMLModel", "ResidualTransformer",
+    "OrthoForestDMLEstimator", "OrthoForestDMLModel",
+    "DiffInDiffEstimator", "DiffInDiffModel",
+    "SyntheticControlEstimator", "SyntheticDiffInDiffEstimator",
+    "mirror_descent", "constrained_least_square",
+]

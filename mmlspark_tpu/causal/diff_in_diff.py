@@ -1,0 +1,186 @@
+"""Diff-in-diff + synthetic control estimators.
+
+Parity: causal/DiffInDiffEstimator.scala (2×2 OLS with interaction:
+Y ~ treat + post + treat·post; the interaction coefficient is the
+treatment effect, with its OLS standard error),
+SyntheticControlEstimator.scala (simplex-constrained unit weights fit on
+pre-treatment control outcomes via mirror descent), and
+SyntheticDiffInDiffEstimator.scala (unit AND time weights, then the
+weighted 2×2 DiD — Arkhangelsky et al.'s SDID, which the reference
+implements with the same two mirror-descent solves).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import Param, Params, ge, to_float, to_str
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.causal.opt import mirror_descent
+
+
+class _DiDParams(Params):
+    treatmentCol = Param("treatmentCol", "0/1 treated-unit indicator", to_str,
+                         default="treatment")
+    postTreatmentCol = Param("postTreatmentCol", "0/1 post-period indicator",
+                             to_str, default="postTreatment")
+    outcomeCol = Param("outcomeCol", "outcome column", to_str,
+                       default="outcome")
+    unitCol = Param("unitCol", "unit id column (panel data)", to_str,
+                    default="unit")
+    timeCol = Param("timeCol", "time id column (panel data)", to_str,
+                    default="time")
+
+
+class DiffInDiffModel(Model, _DiDParams):
+    summary: Dict[str, float]
+
+    def _get_state(self):
+        return {"summary": self.summary}
+
+    def _set_state(self, state):
+        self.summary = dict(state["summary"])
+
+    @property
+    def treatment_effect(self) -> float:
+        return self.summary["treatmentEffect"]
+
+    @property
+    def standard_error(self) -> float:
+        return self.summary["standardError"]
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        return dataset.with_column(
+            "treatmentEffect",
+            np.full(dataset.num_rows, self.treatment_effect))
+
+
+class DiffInDiffEstimator(Estimator, _DiDParams):
+    def _fit(self, dataset: DataFrame) -> DiffInDiffModel:
+        import jax.numpy as jnp
+
+        t = np.asarray(dataset.col(self.get("treatmentCol")), np.float64)
+        post = np.asarray(dataset.col(self.get("postTreatmentCol")),
+                          np.float64)
+        y = np.asarray(dataset.col(self.get("outcomeCol")), np.float64)
+        x = np.stack([np.ones_like(t), t, post, t * post], axis=1)
+        # OLS on device: interaction coefficient is the DiD effect
+        xd = jnp.asarray(x)
+        yd = jnp.asarray(y)
+        beta = jnp.linalg.solve(xd.T @ xd, xd.T @ yd)
+        resid = yd - xd @ beta
+        n, k = x.shape
+        sigma2 = jnp.sum(resid ** 2) / (n - k)
+        cov = sigma2 * jnp.linalg.inv(xd.T @ xd)
+        model = DiffInDiffModel(**{p.name: v
+                                   for p, v in self.iter_set_params()})
+        model.summary = {"treatmentEffect": float(beta[3]),
+                         "standardError": float(jnp.sqrt(cov[3, 3]))}
+        return model
+
+
+class _PanelMatrices:
+    """Pivot panel rows into a (units × times) outcome matrix."""
+
+    def __init__(self, dataset: DataFrame, unit_col: str, time_col: str,
+                 outcome_col: str, treat_col: str, post_col: str):
+        units = dataset.col(unit_col)
+        times = dataset.col(time_col)
+        self.unit_ids = list(dict.fromkeys(units.tolist()))
+        self.time_ids = sorted(dict.fromkeys(times.tolist()))
+        u_of = {u: i for i, u in enumerate(self.unit_ids)}
+        t_of = {t: i for i, t in enumerate(self.time_ids)}
+        self.y = np.zeros((len(self.unit_ids), len(self.time_ids)))
+        self.treated_unit = np.zeros(len(self.unit_ids), bool)
+        self.post_time = np.zeros(len(self.time_ids), bool)
+        y = dataset.col(outcome_col)
+        treat = dataset.col(treat_col)
+        post = dataset.col(post_col)
+        for i in range(dataset.num_rows):
+            ui, ti = u_of[units[i]], t_of[times[i]]
+            self.y[ui, ti] = y[i]
+            if treat[i]:
+                self.treated_unit[ui] = True
+            if post[i]:
+                self.post_time[ti] = True
+        if not self.treated_unit.any() or not self.post_time.any():
+            raise ValueError("need at least one treated unit and one "
+                             "post-treatment period")
+
+
+class SyntheticControlEstimator(Estimator, _DiDParams):
+    """Unit weights on the control donor pool matching pre-period
+    treated outcomes (SyntheticControlEstimator.scala)."""
+
+    unitL2 = Param("unitL2", "L2 regularization of unit weights", to_float,
+                   ge(0), default=0.0)
+
+    def _fit(self, dataset: DataFrame) -> DiffInDiffModel:
+        p = _PanelMatrices(dataset, self.get("unitCol"), self.get("timeCol"),
+                           self.get("outcomeCol"), self.get("treatmentCol"),
+                           self.get("postTreatmentCol"))
+        pre = ~p.post_time
+        ctrl = ~p.treated_unit
+        # A: (pre_times × control_units); b: mean treated pre outcome
+        a = p.y[ctrl][:, pre].T
+        b = p.y[p.treated_unit][:, pre].mean(axis=0)
+        w = mirror_descent(a, b, l2=self.get("unitL2"))
+        synth_post = w @ p.y[ctrl][:, p.post_time]
+        treated_post = p.y[p.treated_unit][:, p.post_time].mean(axis=0)
+        effects = treated_post - synth_post
+        model = DiffInDiffModel(**{pp.name: v
+                                   for pp, v in self.iter_set_params()
+                                   if DiffInDiffModel.has_param(pp.name)})
+        model.summary = {
+            "treatmentEffect": float(effects.mean()),
+            "standardError": float(effects.std(ddof=1)
+                                   / np.sqrt(max(len(effects), 1)))
+            if len(effects) > 1 else 0.0,
+            "unitWeights": w.tolist(),
+        }
+        return model
+
+
+class SyntheticDiffInDiffEstimator(Estimator, _DiDParams):
+    """SDID: simplex unit weights + simplex time weights, then the
+    doubly-weighted 2×2 DiD (SyntheticDiffInDiffEstimator.scala)."""
+
+    unitL2 = Param("unitL2", "L2 regularization of unit weights", to_float,
+                   ge(0), default=0.0)
+    timeL2 = Param("timeL2", "L2 regularization of time weights", to_float,
+                   ge(0), default=0.0)
+
+    def _fit(self, dataset: DataFrame) -> DiffInDiffModel:
+        p = _PanelMatrices(dataset, self.get("unitCol"), self.get("timeCol"),
+                           self.get("outcomeCol"), self.get("treatmentCol"),
+                           self.get("postTreatmentCol"))
+        pre = ~p.post_time
+        ctrl = ~p.treated_unit
+        y_ctrl = p.y[ctrl]
+        y_treat = p.y[p.treated_unit]
+
+        # unit weights: control pre-period profiles -> treated pre mean
+        w_unit = mirror_descent(y_ctrl[:, pre].T, y_treat[:, pre].mean(axis=0),
+                                l2=self.get("unitL2"))
+        # time weights: pre-period columns -> post mean, per control unit
+        w_time = mirror_descent(y_ctrl[:, pre], y_ctrl[:, p.post_time]
+                                .mean(axis=1), l2=self.get("timeL2"))
+
+        treated_post = y_treat[:, p.post_time].mean()
+        treated_pre = float(y_treat[:, pre].mean(axis=0) @ w_time)
+        ctrl_post = float(w_unit @ y_ctrl[:, p.post_time].mean(axis=1))
+        ctrl_pre = float(w_unit @ (y_ctrl[:, pre] @ w_time))
+        effect = (treated_post - treated_pre) - (ctrl_post - ctrl_pre)
+
+        model = DiffInDiffModel(**{pp.name: v
+                                   for pp, v in self.iter_set_params()
+                                   if DiffInDiffModel.has_param(pp.name)})
+        model.summary = {"treatmentEffect": float(effect),
+                         "standardError": 0.0,
+                         "unitWeights": w_unit.tolist(),
+                         "timeWeights": w_time.tolist()}
+        return model

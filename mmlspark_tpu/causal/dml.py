@@ -1,0 +1,175 @@
+"""Double Machine Learning (partially linear model).
+
+Parity: causal/DoubleMLEstimator.scala:63 —
+
+1. per bootstrap iteration (``maxIter`` draws with replacement;
+   iteration 1 uses the data as-is), split by ``sampleSplitRatio``;
+2. fit treatment + outcome nuisance models on one half, compute
+   residuals on the other, and cross-fit the other way
+   (trainInternal, DoubleMLEstimator.scala:142-266);
+3. ATE of the iteration = mean slope of outcome-residual ~
+   treatment-residual OLS over both folds;
+4. the model keeps the raw per-iteration effects: average = ATE,
+   percentile CI (getConfidenceInterval), sign-test p-value.
+
+``ResidualTransformer`` (causal/ResidualTransformer.scala) is the
+observed-minus-predicted column stage used inside.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.logging_utils import logger
+from mmlspark_tpu.core.param import (
+    HasWeightCol, Param, gt, in_range, to_float, to_int, to_list, to_str,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+
+
+class ResidualTransformer(Transformer):
+    """residual = observed - predicted (causal/ResidualTransformer.scala)."""
+
+    observedCol = Param("observedCol", "observed column", to_str)
+    predictedCol = Param("predictedCol", "predicted column", to_str)
+    outputCol = Param("outputCol", "residual column", to_str,
+                      default="residual")
+    classIndex = Param("classIndex", "probability column class index", to_int,
+                       default=1)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        obs = np.asarray(dataset.col(self.get("observedCol")), np.float64)
+        pred = dataset.col(self.get("predictedCol"))
+        if pred.ndim == 2:  # probability vector -> P(class)
+            pred = pred[:, self.get("classIndex")]
+        return dataset.with_column(self.get("outputCol"),
+                                   obs - np.asarray(pred, np.float64))
+
+
+class _DMLParams(HasWeightCol):
+    treatmentModel = Param("treatmentModel", "nuisance model for T ~ X",
+                           is_complex=True)
+    outcomeModel = Param("outcomeModel", "nuisance model for Y ~ X",
+                         is_complex=True)
+    treatmentCol = Param("treatmentCol", "treatment column", to_str,
+                         default="treatment")
+    outcomeCol = Param("outcomeCol", "outcome column", to_str,
+                       default="outcome")
+    featuresCol = Param("featuresCol", "confounder feature vector column",
+                        to_str, default="features")
+    sampleSplitRatio = Param("sampleSplitRatio", "two-way split ratio",
+                             to_list(to_float), default=[0.5, 0.5])
+    maxIter = Param("maxIter", "bootstrap iterations", to_int, gt(0),
+                    default=1)
+    parallelism = Param("parallelism", "concurrent bootstrap fits", to_int,
+                        gt(0), default=2)
+    confidenceLevel = Param("confidenceLevel", "CI level", to_float,
+                            in_range(0.0, 1.0, lo_inclusive=False,
+                                     hi_inclusive=False), default=0.975)
+    seed = Param("seed", "rng seed", to_int, default=0)
+
+
+def _score_col(model: Model, scored: DataFrame) -> np.ndarray:
+    """Nuisance prediction: probability of class 1 if present, else the
+    prediction column."""
+    if "probability" in scored:
+        p = scored.col("probability")
+        return np.asarray(p[:, -1] if p.ndim == 2 else p, np.float64)
+    pred_col = model.get("predictionCol") \
+        if model.has_param("predictionCol") else "prediction"
+    return np.asarray(scored.col(pred_col), np.float64)
+
+
+class DoubleMLEstimator(Estimator, _DMLParams):
+    def _residuals(self, train: DataFrame, test: DataFrame):
+        tm = self.get("treatmentModel").copy(
+            labelCol=self.get("treatmentCol"),
+            featuresCol=self.get("featuresCol"))
+        om = self.get("outcomeModel").copy(
+            labelCol=self.get("outcomeCol"),
+            featuresCol=self.get("featuresCol"))
+        if self.is_set("weightCol"):
+            for m in (tm, om):
+                if not m.has_param("weightCol"):
+                    raise ValueError(
+                        f"{type(m).__name__} does not support weightCol, but "
+                        "weightCol was set on the DoubleMLEstimator")
+                m.set("weightCol", self.get("weightCol"))
+        t_hat = _score_col(tm, tm.fit(train).transform(test))
+        y_hat = _score_col(om, om.fit(train).transform(test))
+        t_res = np.asarray(test.col(self.get("treatmentCol")),
+                           np.float64) - t_hat
+        y_res = np.asarray(test.col(self.get("outcomeCol")),
+                           np.float64) - y_hat
+        return t_res, y_res
+
+    def _one_ate(self, dataset: DataFrame, seed: int) -> float:
+        ratio = self.get("sampleSplitRatio")
+        a, b = dataset.random_split(ratio, seed=seed)
+        slopes = []
+        for train, test in ((a, b), (b, a)):
+            t_res, y_res = self._residuals(train, test)
+            # OLS slope with intercept: cov / var
+            t_c = t_res - t_res.mean()
+            denom = float(t_c @ t_c)
+            if denom <= 1e-12:
+                raise ValueError("degenerate treatment residuals")
+            slopes.append(float(t_c @ (y_res - y_res.mean())) / denom)
+        return float(np.mean(slopes))
+
+    def _fit(self, dataset: DataFrame) -> "DoubleMLModel":
+        max_iter = self.get("maxIter")
+        rng = np.random.default_rng(self.get("seed"))
+
+        def one(i: int) -> Optional[float]:
+            try:
+                if max_iter == 1:
+                    df = dataset
+                else:  # bootstrap redraw, DoubleMLEstimator.scala:110
+                    idx = rng.integers(0, dataset.num_rows,
+                                       size=dataset.num_rows)
+                    df = dataset.take_rows(idx)
+                return self._one_ate(df, seed=self.get("seed") + i)
+            except Exception as ex:  # parity: failed iterations are skipped
+                logger.warning("ATE iteration %d failed: %s", i, ex)
+                return None
+
+        with ThreadPoolExecutor(max_workers=self.get("parallelism")) as pool:
+            ates = [a for a in pool.map(one, range(max_iter)) if a is not None]
+        if not ates:
+            raise RuntimeError("ATE calculation failed on all iterations")
+        model = DoubleMLModel(
+            **{p.name: v for p, v in self.iter_set_params()})
+        model._set(rawTreatmentEffects=[float(a) for a in ates])
+        return model
+
+
+class DoubleMLModel(Model, _DMLParams):
+    rawTreatmentEffects = Param("rawTreatmentEffects",
+                                "per-iteration ATE values", is_complex=True)
+
+    def get_avg_treatment_effect(self) -> float:
+        return float(np.mean(self.get("rawTreatmentEffects")))
+
+    def get_confidence_interval(self) -> List[float]:
+        effects = np.asarray(self.get("rawTreatmentEffects"))
+        level = self.get("confidenceLevel")
+        lo = float(np.percentile(effects, 100 * (1 - level)))
+        hi = float(np.percentile(effects, 100 * level))
+        return [lo, hi]
+
+    def get_pvalue(self) -> float:
+        """Sign-flip p-value over bootstrap effects
+        (DoubleMLModel.getPValue semantics)."""
+        effects = np.asarray(self.get("rawTreatmentEffects"))
+        frac = (effects > 0).mean()
+        return float(2 * min(frac, 1 - frac))
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        return dataset.with_column(
+            "treatmentEffect",
+            np.full(dataset.num_rows, self.get_avg_treatment_effect()))

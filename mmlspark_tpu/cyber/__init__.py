@@ -1,0 +1,29 @@
+"""CyberML: access-pattern anomaly detection + feature utilities.
+
+Parity surface: reference ``cyber`` Python package
+(core/src/main/python/synapse/ml/cyber/: anomaly/collaborative_filtering.py,
+anomaly/complement_access.py, feature/scalers.py, feature/indexers.py).
+"""
+
+from mmlspark_tpu.cyber.anomaly import (
+    AccessAnomaly,
+    AccessAnomalyConfig,
+    AccessAnomalyModel,
+    ComplementAccessTransformer,
+)
+from mmlspark_tpu.cyber.feature import (
+    IdIndexer,
+    IdIndexerModel,
+    LinearScalarScaler,
+    PartitionedMinMaxScaler,
+    PartitionedStandardScaler,
+    StandardScalarScaler,
+)
+
+__all__ = [
+    "AccessAnomaly", "AccessAnomalyModel", "AccessAnomalyConfig",
+    "ComplementAccessTransformer",
+    "IdIndexer", "IdIndexerModel",
+    "StandardScalarScaler", "LinearScalarScaler",
+    "PartitionedStandardScaler", "PartitionedMinMaxScaler",
+]

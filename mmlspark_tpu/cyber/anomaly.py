@@ -1,0 +1,297 @@
+"""Access-pattern anomaly detection via collaborative filtering.
+
+Parity: cyber/anomaly/collaborative_filtering.py (AccessAnomaly: per
+tenant, factorize the (user × resource) likelihood matrix; unseen
+accesses get complement samples at ``complementsetFactor``; the model
+emits an anomaly score normalized to mean 0 / std 1 where HIGH = more
+anomalous, i.e. low predicted affinity — ModelNormalizeTransformer) and
+cyber/anomaly/complement_access.py (ComplementAccessTransformer:
+random (user, res) tuples outside the observed access set).
+
+TPU-first: instead of Spark ALS, the factorization is a jitted Adam
+loop over embedding tables with gather/scatter updates — one compile,
+all tenants packed into one problem via index offsets.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (
+    Param, Params, gt, to_float, to_int, to_str,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+
+
+class AccessAnomalyConfig:
+    """Default column names (cyber AccessAnomalyConfig)."""
+
+    default_tenant_col = "tenant"
+    default_user_col = "user"
+    default_res_col = "res"
+    default_likelihood_col = "likelihood"
+    default_output_col = "anomaly_score"
+
+
+class ComplementAccessTransformer(Transformer):
+    """Emit (tenant, user, res) tuples NOT present in the input
+    (complement_access.py): per tenant, sample ``factor`` × observed
+    count random pairs and keep the unseen ones."""
+
+    tenantCol = Param("tenantCol", "tenant column", to_str,
+                      default=AccessAnomalyConfig.default_tenant_col)
+    indexedUserCol = Param("indexedUserCol", "indexed user column", to_str,
+                           default="user_idx")
+    indexedResCol = Param("indexedResCol", "indexed resource column", to_str,
+                          default="res_idx")
+    complementsetFactor = Param("complementsetFactor", "complement size "
+                                "multiplier", to_int, gt(0), default=2)
+    seed = Param("seed", "rng seed", to_int, default=0)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        rng = np.random.default_rng(self.get("seed"))
+        t_col, u_col, r_col = (self.get("tenantCol"),
+                               self.get("indexedUserCol"),
+                               self.get("indexedResCol"))
+        rows = {t_col: [], u_col: [], r_col: []}
+        for tenant, idx in dataset.group_indices(t_col).items():
+            users = dataset.col(u_col)[idx]
+            ress = dataset.col(r_col)[idx]
+            seen = set(zip(users.tolist(), ress.tolist()))
+            uniq_u = np.unique(users)
+            uniq_r = np.unique(ress)
+            want = len(idx) * self.get("complementsetFactor")
+            cand_u = rng.choice(uniq_u, size=want * 2)
+            cand_r = rng.choice(uniq_r, size=want * 2)
+            added = 0
+            for u, r in zip(cand_u, cand_r):
+                if added >= want:
+                    break
+                if (u, r) not in seen:
+                    seen.add((u, r))
+                    rows[t_col].append(tenant)
+                    rows[u_col].append(int(u))
+                    rows[r_col].append(int(r))
+                    added += 1
+        return DataFrame({t_col: np.asarray(rows[t_col]),
+                          u_col: np.asarray(rows[u_col], np.int64),
+                          r_col: np.asarray(rows[r_col], np.int64)})
+
+
+def _factorize(u_idx: np.ndarray, r_idx: np.ndarray, y: np.ndarray,
+               n_users: int, n_res: int, rank: int, reg: float,
+               iters: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Jitted Adam matrix factorization: min Σ (uᵢ·vⱼ - y)² + reg·(|U|²+|V|²)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    u0 = jnp.asarray(rng.normal(scale=0.1, size=(n_users, rank)), jnp.float32)
+    v0 = jnp.asarray(rng.normal(scale=0.1, size=(n_res, rank)), jnp.float32)
+    ui = jnp.asarray(u_idx)
+    ri = jnp.asarray(r_idx)
+    yd = jnp.asarray(y, jnp.float32)
+
+    def loss(params):
+        u, v = params
+        pred = jnp.sum(u[ui] * v[ri], axis=1)
+        return jnp.mean((pred - yd) ** 2) + reg * (jnp.mean(u ** 2)
+                                                   + jnp.mean(v ** 2))
+
+    @jax.jit
+    def run(u, v):
+        def step(carry, _):
+            params, m, vv, t = carry
+            g = jax.grad(loss)(params)
+            t = t + 1
+            m = jax.tree_util.tree_map(lambda a, b: 0.9 * a + 0.1 * b, m, g)
+            vv = jax.tree_util.tree_map(lambda a, b: 0.999 * a + 0.001 * b ** 2,
+                                        vv, g)
+            def upd(p, mi, vi):
+                mhat = mi / (1 - 0.9 ** t)
+                vhat = vi / (1 - 0.999 ** t)
+                return p - 0.05 * mhat / (jnp.sqrt(vhat) + 1e-8)
+            params = jax.tree_util.tree_map(upd, params, m, vv)
+            return (params, m, vv, t), None
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, (u, v))
+        (params, _, _, _), _ = jax.lax.scan(
+            step, ((u, v), zeros, zeros, jnp.asarray(0.0)), None,
+            length=iters)
+        return params
+
+    u, v = run(u0, v0)
+    return np.asarray(u, np.float64), np.asarray(v, np.float64)
+
+
+class _AccessAnomalyParams(Params):
+    tenantCol = Param("tenantCol", "tenant column", to_str,
+                      default=AccessAnomalyConfig.default_tenant_col)
+    userCol = Param("userCol", "user column", to_str,
+                    default=AccessAnomalyConfig.default_user_col)
+    resCol = Param("resCol", "resource column", to_str,
+                   default=AccessAnomalyConfig.default_res_col)
+    likelihoodCol = Param("likelihoodCol", "access likelihood column", to_str,
+                          default=AccessAnomalyConfig.default_likelihood_col)
+    outputCol = Param("outputCol", "anomaly score column", to_str,
+                      default=AccessAnomalyConfig.default_output_col)
+    rankParam = Param("rankParam", "latent factors", to_int, gt(0),
+                      default=10)
+    maxIter = Param("maxIter", "optimization steps", to_int, gt(0),
+                    default=200)
+    regParam = Param("regParam", "L2 regularization", to_float, default=0.1)
+    complementsetFactor = Param("complementsetFactor", "complement samples "
+                                "per observed row", to_int, default=2)
+    lowValue = Param("lowValue", "likelihood scale lower bound", to_float,
+                     default=5.0)
+    highValue = Param("highValue", "likelihood scale upper bound", to_float,
+                      default=10.0)
+    seed = Param("seed", "rng seed", to_int, default=0)
+
+
+class AccessAnomaly(Estimator, _AccessAnomalyParams):
+    def _fit(self, dataset: DataFrame) -> "AccessAnomalyModel":
+        from mmlspark_tpu.cyber.feature import (IdIndexer,
+                                                PartitionedMinMaxScaler)
+
+        t_col, u_col, r_col = (self.get("tenantCol"), self.get("userCol"),
+                               self.get("resCol"))
+        lik_col = self.get("likelihoodCol")
+        df = dataset
+        if lik_col not in df:
+            df = df.with_column(lik_col, np.ones(df.num_rows))
+
+        # 1. per-tenant indexing of users and resources
+        u_indexer = IdIndexer(inputCol=u_col, outputCol="__u__",
+                              partitionKey=t_col).fit(df)
+        r_indexer = IdIndexer(inputCol=r_col, outputCol="__r__",
+                              partitionKey=t_col).fit(df)
+        df = r_indexer.transform(u_indexer.transform(df))
+
+        # 2. scale likelihood into [lowValue, highValue]
+        scaler = PartitionedMinMaxScaler(
+            inputCol=lik_col, outputCol="__y__", partitionKey=t_col,
+            minRequiredValue=self.get("lowValue"),
+            maxRequiredValue=self.get("highValue")).fit(df)
+        df = scaler.transform(df)
+
+        # 3. complement samples at value 0
+        comp = ComplementAccessTransformer(
+            tenantCol=t_col, indexedUserCol="__u__", indexedResCol="__r__",
+            complementsetFactor=self.get("complementsetFactor"),
+            seed=self.get("seed")).transform(df)
+
+        # 4. pack all tenants into one factorization via index offsets
+        tenants = list(df.group_indices(t_col).keys())
+        u_off: Dict = {}
+        r_off: Dict = {}
+        nu = nr = 0
+        for t in tenants:
+            idx = df.group_indices(t_col)[t]
+            u_off[t] = nu
+            r_off[t] = nr
+            nu += int(df.col("__u__")[idx].max()) + 1
+            nr += int(df.col("__r__")[idx].max()) + 1
+
+        def packed(frame: DataFrame, y_vals: Optional[np.ndarray]):
+            us = np.asarray([u_off[t] + u for t, u in
+                             zip(frame.col(t_col), frame.col("__u__"))],
+                            np.int64)
+            rs = np.asarray([r_off[t] + r for t, r in
+                             zip(frame.col(t_col), frame.col("__r__"))],
+                            np.int64)
+            ys = y_vals if y_vals is not None else np.zeros(len(us))
+            return us, rs, ys
+
+        u1, r1, y1 = packed(df, np.asarray(df.col("__y__"), np.float64))
+        u2, r2, y2 = packed(comp, None)
+        u_all = np.concatenate([u1, u2])
+        r_all = np.concatenate([r1, r2])
+        y_all = np.concatenate([y1, y2])
+
+        u_emb, v_emb = _factorize(
+            u_all, r_all, y_all, nu, nr, self.get("rankParam"),
+            self.get("regParam"), self.get("maxIter"), self.get("seed"))
+
+        # 5. normalize: per-tenant mean/std of predicted affinity on the
+        # training pairs (ModelNormalizeTransformer)
+        pred = np.sum(u_emb[u_all] * v_emb[r_all], axis=1)
+        norms: Dict = {}
+        tenant_of_pair = np.concatenate([np.asarray(df.col(t_col)),
+                                         np.asarray(comp.col(t_col))])
+        for t in tenants:
+            p = pred[tenant_of_pair == t]
+            norms[t] = (float(p.mean()), float(p.std()) or 1.0)
+
+        model = AccessAnomalyModel(
+            **{p.name: v for p, v in self.iter_set_params()
+               if AccessAnomalyModel.has_param(p.name)})
+        model._init_state(u_indexer, r_indexer, u_emb, v_emb, u_off, r_off,
+                          norms)
+        return model
+
+
+class AccessAnomalyModel(Model, _AccessAnomalyParams):
+    user_indexer = None
+    res_indexer = None
+    _u_emb: np.ndarray
+    _v_emb: np.ndarray
+    _u_off: Dict
+    _r_off: Dict
+    _norms: Dict
+
+    def _init_state(self, u_indexer, r_indexer, u_emb, v_emb, u_off, r_off,
+                    norms):
+        self.user_indexer = u_indexer
+        self.res_indexer = r_indexer
+        self._u_emb = u_emb
+        self._v_emb = v_emb
+        self._u_off = u_off
+        self._r_off = r_off
+        self._norms = norms
+        return self
+
+    def _get_state(self):
+        import json
+        return {"u_emb": self._u_emb, "v_emb": self._v_emb,
+                "offsets": json.dumps({
+                    "u": {str(k): v for k, v in self._u_off.items()},
+                    "r": {str(k): v for k, v in self._r_off.items()},
+                    "norms": {str(k): list(v) for k, v in self._norms.items()},
+                })}
+
+    def _set_state(self, state):
+        import json
+        self._u_emb = np.asarray(state["u_emb"])
+        self._v_emb = np.asarray(state["v_emb"])
+        meta = json.loads(state["offsets"])
+        self._u_off = meta["u"]
+        self._r_off = meta["r"]
+        self._norms = {k: tuple(v) for k, v in meta["norms"].items()}
+
+    def _off(self, table: Dict, tenant) -> Optional[int]:
+        if tenant in table:
+            return table[tenant]
+        return table.get(str(tenant))
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        df = self.res_indexer.transform(self.user_indexer.transform(dataset))
+        t_col = self.get("tenantCol")
+        scores = np.zeros(df.num_rows)
+        for i in range(df.num_rows):
+            t = df.col(t_col)[i]
+            ui = int(df.col("__u__")[i])
+            ri = int(df.col("__r__")[i])
+            uo, ro = self._off(self._u_off, t), self._off(self._r_off, t)
+            norm = self._norms.get(t, self._norms.get(str(t), (0.0, 1.0)))
+            if not ui or not ri or uo is None or ro is None:
+                scores[i] = 0.0  # unseen user/resource: neutral
+                continue
+            pred = float(self._u_emb[uo + ui] @ self._v_emb[ro + ri])
+            # low affinity => high anomaly
+            scores[i] = (norm[0] - pred) / norm[1]
+        return dataset.with_column(self.get("outputCol"), scores)

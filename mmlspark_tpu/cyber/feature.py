@@ -1,0 +1,194 @@
+"""Per-partition scalers + id indexers.
+
+Parity: cyber/feature/scalers.py (StandardScalarScaler: z-score per
+partition; LinearScalarScaler: min-max to [minRequiredValue,
+maxRequiredValue] per partition) and cyber/feature/indexers.py
+(IdIndexer: per-partition contiguous 1-based ids, undo_transform).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (
+    HasInputCol, HasOutputCol, Param, to_float, to_str,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+
+
+class _PartitionedScalerBase(Estimator, HasInputCol, HasOutputCol):
+    partitionKey = Param("partitionKey", "partition (tenant) column; unset "
+                         "= global stats", to_str)
+
+    def _groups(self, dataset: DataFrame):
+        key = self.get("partitionKey")
+        if key is None:
+            return {None: np.arange(dataset.num_rows)}
+        return dataset.group_indices(key)
+
+
+class PartitionedStandardScaler(_PartitionedScalerBase):
+    """z-score per partition (StandardScalarScaler)."""
+
+    coefficientFactor = Param("coefficientFactor", "multiply the z-score",
+                              to_float, default=1.0)
+
+    def _fit(self, dataset: DataFrame) -> "PartitionedScalerModel":
+        vals = np.asarray(dataset.col(self.get("inputCol")), np.float64)
+        stats = {}
+        for k, idx in self._groups(dataset).items():
+            v = vals[idx]
+            stats[k] = (float(v.mean()), float(v.std()) or 1.0)
+        model = PartitionedScalerModel(
+            **{p.name: v for p, v in self.iter_set_params()
+               if PartitionedScalerModel.has_param(p.name)})
+        model.kind = "standard"
+        model.stats = stats
+        model.factor = self.get("coefficientFactor")
+        return model
+
+
+class PartitionedMinMaxScaler(_PartitionedScalerBase):
+    """min-max per partition to [minRequiredValue, maxRequiredValue]
+    (LinearScalarScaler)."""
+
+    minRequiredValue = Param("minRequiredValue", "output min", to_float,
+                             default=0.0)
+    maxRequiredValue = Param("maxRequiredValue", "output max", to_float,
+                             default=1.0)
+
+    def _fit(self, dataset: DataFrame) -> "PartitionedScalerModel":
+        vals = np.asarray(dataset.col(self.get("inputCol")), np.float64)
+        stats = {}
+        for k, idx in self._groups(dataset).items():
+            v = vals[idx]
+            stats[k] = (float(v.min()), float(v.max()))
+        model = PartitionedScalerModel(
+            **{p.name: v for p, v in self.iter_set_params()
+               if PartitionedScalerModel.has_param(p.name)})
+        model.kind = "minmax"
+        model.stats = stats
+        model.out_range = (self.get("minRequiredValue"),
+                           self.get("maxRequiredValue"))
+        return model
+
+
+# reference-name aliases
+StandardScalarScaler = PartitionedStandardScaler
+LinearScalarScaler = PartitionedMinMaxScaler
+
+
+class PartitionedScalerModel(Model, HasInputCol, HasOutputCol):
+    partitionKey = Param("partitionKey", "partition column", to_str)
+
+    kind: str
+    stats: Dict[Any, Tuple[float, float]]
+    factor: float = 1.0
+    out_range: Tuple[float, float] = (0.0, 1.0)
+
+    def _get_state(self):
+        return {"kind": self.kind, "factor": self.factor,
+                "out_range": list(self.out_range),
+                "stats_keys": [str(k) for k in self.stats],
+                "stats_vals": np.asarray(list(self.stats.values()))}
+
+    def _set_state(self, state):
+        self.kind = state["kind"]
+        self.factor = float(state["factor"])
+        self.out_range = tuple(state["out_range"])
+        keys = [None if k == "None" else k for k in state["stats_keys"]]
+        self.stats = {k: tuple(v) for k, v in
+                      zip(keys, np.asarray(state["stats_vals"]))}
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        vals = np.asarray(dataset.col(self.get("inputCol")), np.float64)
+        key = self.get("partitionKey")
+        out = np.empty_like(vals)
+        groups = {None: np.arange(dataset.num_rows)} if key is None \
+            else dataset.group_indices(key)
+        for k, idx in groups.items():
+            k2 = k if k in self.stats else str(k)
+            a, b = self.stats.get(k2, (0.0, 1.0))
+            if self.kind == "standard":
+                out[idx] = (vals[idx] - a) / (b if b else 1.0) * self.factor
+            else:
+                lo, hi = self.out_range
+                span = (b - a) or 1.0
+                out[idx] = (vals[idx] - a) / span * (hi - lo) + lo
+        return dataset.with_column(self.get("outputCol"), out)
+
+
+class IdIndexer(Estimator, HasInputCol, HasOutputCol):
+    """Per-partition contiguous 1-based ids (cyber/feature/indexers.py)."""
+
+    partitionKey = Param("partitionKey", "partition column", to_str)
+    resetPerPartition = Param("resetPerPartition", "restart ids per "
+                              "partition", default=True, is_complex=False,
+                              converter=lambda v: bool(v))
+
+    def _fit(self, dataset: DataFrame) -> "IdIndexerModel":
+        key = self.get("partitionKey")
+        col = dataset.col(self.get("inputCol"))
+        vocab: Dict[Any, Dict[Any, int]] = {}
+        if key is not None and self.get("resetPerPartition"):
+            for k, idx in dataset.group_indices(key).items():
+                seen: Dict[Any, int] = {}
+                for v in col[idx]:
+                    if v not in seen:
+                        seen[v] = len(seen) + 1
+                vocab[k] = seen
+        else:
+            seen = {}
+            for v in col:
+                if v not in seen:
+                    seen[v] = len(seen) + 1
+            vocab[None] = seen
+        model = IdIndexerModel(
+            **{p.name: v for p, v in self.iter_set_params()
+               if IdIndexerModel.has_param(p.name)})
+        model.vocab = vocab
+        return model
+
+
+class IdIndexerModel(Model, HasInputCol, HasOutputCol):
+    partitionKey = Param("partitionKey", "partition column", to_str)
+
+    vocab: Dict[Any, Dict[Any, int]]
+
+    def _get_state(self):
+        return {"vocab": {str(k): {str(vk): vv for vk, vv in v.items()}
+                          for k, v in self.vocab.items()}}
+
+    def _set_state(self, state):
+        self.vocab = {(None if k == "None" else k):
+                      dict(v) for k, v in state["vocab"].items()}
+
+    def _lookup(self, part: Any) -> Dict[Any, int]:
+        if part in self.vocab:
+            return self.vocab[part]
+        return self.vocab.get(str(part), self.vocab.get(None, {}))
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        key = self.get("partitionKey")
+        col = dataset.col(self.get("inputCol"))
+        out = np.zeros(dataset.num_rows, np.int64)
+        for i, v in enumerate(col):
+            part = dataset.col(key)[i] if key is not None and \
+                None not in self.vocab else None
+            m = self._lookup(part)
+            out[i] = m.get(v, m.get(str(v), 0))  # 0 = unseen
+        return dataset.with_column(self.get("outputCol"), out)
+
+    def undo_transform(self, dataset: DataFrame) -> DataFrame:
+        key = self.get("partitionKey")
+        idx_col = dataset.col(self.get("outputCol"))
+        out = np.empty(dataset.num_rows, dtype=object)
+        for i, ix in enumerate(idx_col):
+            part = dataset.col(key)[i] if key is not None and \
+                None not in self.vocab else None
+            rev = {v: k for k, v in self._lookup(part).items()}
+            out[i] = rev.get(int(ix))
+        return dataset.with_column(self.get("inputCol"), out)

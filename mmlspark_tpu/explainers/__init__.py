@@ -1,0 +1,32 @@
+"""Model-agnostic interpretability: LIME, KernelSHAP, ICE/PDP.
+
+Parity surface: reference ``explainers`` package (LIMEBase.scala:137,
+KernelSHAPBase.scala:1, ICEExplainer.scala:1, Sampler.scala:16,
+LassoRegression.scala:1) over tabular, vector, image and text inputs.
+"""
+
+from mmlspark_tpu.explainers.ice import ICETransformer
+from mmlspark_tpu.explainers.lime import (
+    ImageLIME,
+    TabularLIME,
+    TextLIME,
+    VectorLIME,
+)
+from mmlspark_tpu.explainers.regression import (
+    LassoRegression,
+    LeastSquaresRegression,
+    RegressionResult,
+)
+from mmlspark_tpu.explainers.shap import (
+    ImageSHAP,
+    TabularSHAP,
+    TextSHAP,
+    VectorSHAP,
+)
+
+__all__ = [
+    "TabularLIME", "VectorLIME", "TextLIME", "ImageLIME",
+    "TabularSHAP", "VectorSHAP", "TextSHAP", "ImageSHAP",
+    "ICETransformer",
+    "LassoRegression", "LeastSquaresRegression", "RegressionResult",
+]

@@ -1,0 +1,59 @@
+"""Shared machinery of the local explainers.
+
+Parity: explainers/LocalExplainer.scala + SharedParams.scala — every
+explainer wraps a fitted ``model``, scores perturbed copies of each row,
+extracts a target column (``targetCol``/``targetClasses``), and fits a
+local surrogate per (row, class).
+
+TPU-first: all perturbed samples of all rows are scored in ONE
+``model.transform`` call (one big device batch) instead of the
+reference's per-row UDF sampling; the surrogate solves are jitted
+(:mod:`mmlspark_tpu.explainers.regression`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import Param, gt, to_int, to_list, to_str
+from mmlspark_tpu.core.pipeline import Transformer
+
+
+class LocalExplainer(Transformer):
+    model = Param("model", "fitted model to explain", is_complex=True)
+    targetCol = Param("targetCol", "scored column holding the explained "
+                      "output", to_str, default="probability")
+    targetClasses = Param("targetClasses", "class indices to explain "
+                          "(empty = scalar target)", to_list(to_int),
+                          default=[])
+    outputCol = Param("outputCol", "explanation output column", to_str,
+                      default="explanation")
+    metricsCol = Param("metricsCol", "surrogate-fit metrics column", to_str,
+                       default="r2")
+    numSamples = Param("numSamples", "perturbed samples per row", to_int,
+                       gt(0))
+
+    def _extract_targets(self, scored: DataFrame) -> np.ndarray:
+        """(rows, classes) matrix of explained outputs."""
+        col = scored.col(self.get("targetCol"))
+        classes = self.get("targetClasses")
+        if col.ndim == 2:
+            if not classes:
+                classes = [col.shape[1] - 1]
+            return np.asarray(col[:, classes], np.float64)
+        return np.asarray(col, np.float64)[:, None]
+
+    def _num_classes(self) -> int:
+        classes = self.get("targetClasses")
+        return max(len(classes), 1)
+
+    @staticmethod
+    def _pack_vectors(per_row: List[List[np.ndarray]]) -> np.ndarray:
+        """rows × classes lists of coef vectors -> object column."""
+        out = np.empty(len(per_row), dtype=object)
+        for i, vecs in enumerate(per_row):
+            out[i] = [np.asarray(v, np.float64) for v in vecs]
+        return out

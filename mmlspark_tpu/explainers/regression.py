@@ -1,0 +1,172 @@
+"""Weighted regression solvers for the local explainers.
+
+Parity: explainers/RegressionBase.scala (weight-normalized centering /
+sqrt-weight rescaling / intercept recovery / R² computation),
+explainers/LassoRegression.scala:1 (cyclic coordinate-descent lasso with
+soft thresholding, regularization scaled by ``alpha * n_rows``) and
+explainers/LeastSquaresRegression.scala (normal-equation solve).
+
+TPU-first: both solvers are jitted jnp; the coordinate-descent sweep is
+a ``lax.fori_loop`` over features inside a ``lax.while_loop`` over
+iterations, so one compile serves every (samples × features) shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+
+
+@dataclass
+class RegressionResult:
+    coefficients: np.ndarray
+    intercept: float
+    r_squared: float
+    loss: float
+
+    def __call__(self, x: np.ndarray) -> float:
+        return float(np.dot(self.coefficients, x) + self.intercept)
+
+
+def _prepare(x, y, sample_weights, fit_intercept):
+    """Center by weighted mean, rescale by sqrt(weight) — RegressionBase.fit
+    steps 1-2. Returns device arrays + offsets."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    w = jnp.ones(x.shape[0], jnp.float32) if sample_weights is None \
+        else jnp.asarray(sample_weights, jnp.float32)
+    w = w * (w.shape[0] / jnp.sum(w))  # normalizeSampleWeights
+    if fit_intercept:
+        x_off = jnp.sum(x * w[:, None], axis=0) / jnp.sum(w)
+        y_off = jnp.sum(y * w) / jnp.sum(w)
+        xc, yc = x - x_off, y - y_off
+    else:
+        x_off = jnp.zeros(x.shape[1], x.dtype)
+        y_off = jnp.asarray(0.0, x.dtype)
+        xc, yc = x, y
+    sw = jnp.sqrt(w)
+    return xc * sw[:, None], yc * sw, x_off, y_off, w
+
+
+def _finish(x, y, w, beta, x_off, y_off, fit_intercept, extra_loss=0.0):
+    import jax.numpy as jnp
+
+    intercept = jnp.where(fit_intercept, y_off - jnp.dot(x_off, beta), 0.0)
+    est = x @ beta + intercept
+    resid = y - est
+    loss = jnp.sum(w * resid ** 2) + extra_loss
+    y_mean = jnp.sum(w * y) / jnp.sum(w)
+    ss_tot = jnp.sum(w * (y - y_mean) ** 2)
+    r2 = 1.0 - jnp.sum(w * resid ** 2) / jnp.maximum(ss_tot, 1e-12)
+    return intercept, loss, r2
+
+
+def _lasso_kernel():
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnums=(5,))
+    def solve(xs, ys, x_raw, y_raw, w, fit_intercept, alpha, max_iter, tol):
+        xr, yr, x_off, y_off = xs
+        n, d = xr.shape
+        sq = jnp.sum(xr ** 2, axis=0)  # per-feature squared norms
+        lam = alpha * n
+
+        def soft(v):
+            return jnp.sign(v) * jnp.maximum(jnp.abs(v) - lam, 0.0)
+
+        def sweep(beta):
+            def body(j, b):
+                b = b.at[j].set(0.0)
+                r = yr - xr @ b
+                arg = jnp.dot(xr[:, j], r)
+                bj = jnp.where(sq[j] > 0, soft(arg) / jnp.maximum(sq[j], 1e-30),
+                               0.0)
+                return b.at[j].set(bj)
+            return jax.lax.fori_loop(0, d, body, beta)
+
+        def cond(state):
+            beta, prev, it = state
+            return (it < max_iter) & ~jnp.all(jnp.abs(beta - prev) <= tol)
+
+        def body(state):
+            beta, _, it = state
+            return sweep(beta), beta, it + 1
+
+        beta0 = jnp.zeros(d, xr.dtype)
+        beta, _, _ = jax.lax.while_loop(
+            cond, body, (sweep(beta0), beta0, jnp.asarray(1)))
+        intercept, loss, r2 = _finish(
+            x_raw, y_raw, w, beta, x_off, y_off, fit_intercept,
+            extra_loss=alpha * jnp.sum(jnp.abs(beta)))
+        return beta, intercept, loss, r2
+
+    return solve
+
+
+class LassoRegression:
+    """Coordinate-descent lasso (LassoRegression.scala:1)."""
+
+    def __init__(self, alpha: float, max_iterations: int = 1000,
+                 tol: float = 1e-5):
+        self.alpha = float(alpha)
+        self.max_iterations = int(max_iterations)
+        self.tol = float(tol)
+
+    def fit(self, x, y, sample_weights=None,
+            fit_intercept: bool = True) -> RegressionResult:
+        import jax.numpy as jnp
+
+        xr, yr, x_off, y_off, w = _prepare(x, y, sample_weights, fit_intercept)
+        beta, intercept, loss, r2 = _lasso_kernel()(
+            (xr, yr, x_off, y_off), None,
+            jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32), w,
+            bool(fit_intercept), self.alpha, self.max_iterations, self.tol)
+        return RegressionResult(np.asarray(beta, np.float64),
+                                float(intercept), float(r2), float(loss))
+
+
+class LeastSquaresRegression:
+    """Ridge-regularized least squares (LeastSquaresRegression.scala).
+
+    Solved host-side in float64: KernelSHAP pins the empty/full
+    coalitions with ~1e8 weights, which float32 normal equations cannot
+    carry (the informative low-weight rows fall below the float32
+    mantissa). The solve is a (d×d) system — microseconds on host; the
+    expensive part of SHAP (model scoring) stays on device.
+    """
+
+    def __init__(self, l2: float = 1e-10):
+        self.l2 = float(l2)
+
+    def fit(self, x, y, sample_weights=None,
+            fit_intercept: bool = True) -> RegressionResult:
+        x = np.asarray(x, np.float64)
+        y = np.asarray(y, np.float64)
+        w = np.ones(len(x)) if sample_weights is None \
+            else np.asarray(sample_weights, np.float64)
+        w = w * (len(w) / w.sum())
+        if fit_intercept:
+            x_off = (x * w[:, None]).sum(axis=0) / w.sum()
+            y_off = float((y * w).sum() / w.sum())
+            xc, yc = x - x_off, y - y_off
+        else:
+            x_off = np.zeros(x.shape[1])
+            y_off = 0.0
+            xc, yc = x, y
+        sw = np.sqrt(w)
+        xr, yr = xc * sw[:, None], yc * sw
+        d = x.shape[1]
+        gram = xr.T @ xr + self.l2 * np.eye(d)
+        beta = np.linalg.solve(gram, xr.T @ yr)
+        intercept = y_off - float(x_off @ beta) if fit_intercept else 0.0
+        resid = y - (x @ beta + intercept)
+        loss = float((w * resid ** 2).sum())
+        y_mean = float((w * y).sum() / w.sum())
+        ss_tot = float((w * (y - y_mean) ** 2).sum())
+        r2 = 1.0 - loss / max(ss_tot, 1e-12)
+        return RegressionResult(beta, intercept, r2, loss)

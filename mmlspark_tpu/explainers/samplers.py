@@ -1,0 +1,152 @@
+"""Perturbation samplers for LIME / KernelSHAP.
+
+Parity: explainers/Sampler.scala:16 + FeatureStats.scala —
+
+- continuous features: sample ~ N(instance, background stddev); state is
+  the raw sampled value; distance contribution |Δ|/σ
+  (ContinuousFeatureStats);
+- discrete features: sample from the background frequency table; state
+  becomes 1 iff the draw equals the instance value
+  (DiscreteFeatureStats + LIMETabularSampler.sample);
+- on/off (text tokens, image superpixels): Bernoulli(samplingFraction)
+  masks, distance ``|1-state| / sqrt(d)`` (LIMEOnOffSampler,
+  LIMESampler.getDistance);
+- KernelSHAP coalitions: enumerate complete subset sizes while the
+  budget allows (paired with complements), then sample the tail; Shapley
+  kernel weight (m-1)/(s(m-s)); the all-0/all-1 rows carry ``infWeight``
+  (KernelSHAPSampler.scala:44-120, KernelSHAPBase.getEffectiveNumSamples).
+"""
+
+from __future__ import annotations
+
+import itertools
+from math import comb
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+class ContinuousFeatureStats:
+    def __init__(self, stddev: float):
+        self.stddev = float(stddev)
+
+    @staticmethod
+    def from_background(values: np.ndarray) -> "ContinuousFeatureStats":
+        return ContinuousFeatureStats(float(np.std(np.asarray(values,
+                                                              np.float64))))
+
+    def random_states(self, instance: float, n: int, rng) -> np.ndarray:
+        return rng.normal(instance, self.stddev, size=n)
+
+    def sample(self, state: np.ndarray) -> np.ndarray:
+        return state
+
+    def distance(self, instance: float, sample: np.ndarray) -> np.ndarray:
+        if self.stddev == 0.0:
+            return np.zeros(len(sample))
+        return np.abs(sample - instance) / self.stddev
+
+
+class DiscreteFeatureStats:
+    def __init__(self, freq: Dict[Any, float]):
+        self.values = list(freq.keys())
+        w = np.asarray(list(freq.values()), np.float64)
+        self.probs = w / w.sum()
+
+    @staticmethod
+    def from_background(values: Sequence[Any]) -> "DiscreteFeatureStats":
+        freq: Dict[Any, float] = {}
+        for v in values:
+            freq[v] = freq.get(v, 0.0) + 1.0
+        return DiscreteFeatureStats(freq)
+
+    def draw(self, n: int, rng) -> np.ndarray:
+        idx = rng.choice(len(self.values), size=n, p=self.probs)
+        out = np.empty(n, dtype=object)
+        for i, j in enumerate(idx):
+            out[i] = self.values[j]
+        return out
+
+
+def lime_tabular_samples(instance: Dict[str, Any], stats: Dict[str, Any],
+                         num: int, rng) -> Tuple[Dict[str, np.ndarray],
+                                                 np.ndarray, np.ndarray]:
+    """Returns (samples per column, states (num, d), distances (num,))."""
+    cols = list(stats.keys())
+    d = len(cols)
+    states = np.zeros((num, d))
+    dists = np.zeros((num, d))
+    samples: Dict[str, np.ndarray] = {}
+    for j, c in enumerate(cols):
+        st = stats[c]
+        if isinstance(st, ContinuousFeatureStats):
+            drawn = st.random_states(float(instance[c]), num, rng)
+            samples[c] = drawn
+            states[:, j] = drawn
+            dists[:, j] = st.distance(float(instance[c]), drawn)
+        else:
+            drawn = st.draw(num, rng)
+            samples[c] = drawn
+            match = np.asarray([v == instance[c] for v in drawn])
+            states[:, j] = match.astype(np.float64)
+            dists[:, j] = (~match).astype(np.float64)
+    distance = np.linalg.norm(dists, axis=1) / np.sqrt(d)
+    return samples, states, distance
+
+
+def onoff_masks(d: int, fraction: float, num: int, rng
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """(masks (num, d) of 0/1, normalized distances (num,))."""
+    masks = (rng.random((num, d)) <= fraction).astype(np.float64)
+    distance = np.linalg.norm(1.0 - masks, axis=1) / np.sqrt(d)
+    return masks, distance
+
+
+def effective_num_samples(num_samples, m: int) -> int:
+    """KernelSHAPBase.getEffectiveNumSamples: clip to [m+2, 2^m], default
+    2m + 2048."""
+    value = num_samples if num_samples else 2 * m + 2048
+    max_needed = 2 ** m if m < 31 else 2 ** 31 - 1
+    return int(min(max(value, m + 2), max_needed))
+
+
+def kernel_shap_coalitions(m: int, num_samples: int, inf_weight: float,
+                           rng) -> Tuple[np.ndarray, np.ndarray]:
+    """(coalitions (n, m), weights (n,)); rows 0/1 are empty/full with
+    inf_weight."""
+    coalitions: List[np.ndarray] = [np.zeros(m), np.ones(m)]
+    weights: List[float] = [inf_weight, inf_weight]
+    budget = max(num_samples - 2, 0)
+
+    def kernel_weight(s: int) -> float:
+        return (m - 1) / (s * (m - s))
+
+    sizes = sorted({min(s, m - s) for s in range(1, m)})
+    leftover_sizes: List[int] = []
+    for s in sizes:
+        paired = s != m - s
+        count = comb(m, s) * (2 if paired else 1)
+        if count <= budget:
+            for combo in itertools.combinations(range(m), s):
+                z = np.zeros(m)
+                z[list(combo)] = 1.0
+                coalitions.append(z)
+                weights.append(kernel_weight(s))
+                if paired:
+                    coalitions.append(1.0 - z)
+                    weights.append(kernel_weight(m - s))
+            budget -= count
+        else:
+            leftover_sizes.append(s)
+    if budget > 0 and leftover_sizes:
+        kw = np.asarray([kernel_weight(s) for s in leftover_sizes])
+        probs = kw / kw.sum()
+        for _ in range(budget):
+            s = int(rng.choice(leftover_sizes, p=probs))
+            s_eff = s if (s == m - s or rng.random() < 0.5) else m - s
+            combo = rng.choice(m, size=s_eff, replace=False)
+            z = np.zeros(m)
+            z[combo] = 1.0
+            coalitions.append(z)
+            weights.append(kernel_weight(s_eff))
+    return np.stack(coalitions), np.asarray(weights)

@@ -54,9 +54,14 @@ class Featurize(Estimator, HasOutputCol):
                           default=True)
 
     def _fit(self, dataset: DataFrame) -> PipelineModel:
+        if not self.get("inputCols"):
+            # require explicit columns: an all-columns default would leak
+            # the label into the feature vector (the reference's callers
+            # always pass the feature columns, TrainClassifier.scala:120+)
+            raise ValueError("Featurize requires inputCols")
         stages = []
         assembled = []
-        for c in self.get("inputCols") or dataset.columns:
+        for c in self.get("inputCols"):
             arr = dataset.col(c)
             if arr.dtype != object and np.issubdtype(arr.dtype, np.number):
                 if (self.get("imputeMissing") and arr.ndim == 1

@@ -1,0 +1,18 @@
+"""Image ops: transformer pipeline, augmentation, unrolling, superpixels.
+
+Parity surface: reference ``opencv`` module (ImageTransformer.scala:31,429,
+ImageSetAugmenter.scala:18) and core ``image`` package
+(image/Superpixel.scala:147, image/SuperpixelTransformer.scala:37,
+image/UnrollImage.scala:169). The native OpenCV C++ engine is replaced
+by jax/XLA image kernels batched over same-shaped images (SURVEY.md §2.7).
+"""
+
+from mmlspark_tpu.image.superpixel import Superpixel, SuperpixelTransformer
+from mmlspark_tpu.image.transformer import (
+    ImageSetAugmenter,
+    ImageTransformer,
+    UnrollImage,
+)
+
+__all__ = ["ImageTransformer", "ImageSetAugmenter", "UnrollImage",
+           "Superpixel", "SuperpixelTransformer"]

@@ -1,0 +1,123 @@
+"""SLIC superpixel segmentation.
+
+Parity: image/Superpixel.scala:147 (SLIC-style clustering used by the
+image explainers' masking) and image/SuperpixelTransformer.scala:37
+(adds a superpixel column with cluster pixel lists).
+
+TPU-first: the assignment step is a dense (pixels × clusters) distance
+computation in one jitted kernel per iteration — XLA tiles it; the
+reference's per-pixel Scala loops disappear. Cluster count follows the
+(cellSize, modifier) parameterization of the reference.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (
+    HasInputCol, HasOutputCol, Param, gt, to_float,
+)
+from mmlspark_tpu.core.pipeline import Transformer
+
+
+def _slic_kernel():
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnums=(3,))
+    def run(features, centers, weight, iters):
+        # features: (p, 5) [y, x, r, g, b]; centers: (k, 5)
+        def step(c, _):
+            d_col = ((features[:, None, 2:] - c[None, :, 2:]) ** 2).sum(-1)
+            d_pos = ((features[:, None, :2] - c[None, :, :2]) ** 2).sum(-1)
+            dist = d_col + weight * d_pos
+            assign = jnp.argmin(dist, axis=1)
+            one_hot = jax.nn.one_hot(assign, c.shape[0], dtype=features.dtype)
+            sums = one_hot.T @ features
+            counts = one_hot.sum(axis=0)[:, None]
+            new_c = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), c)
+            return new_c, assign
+
+        centers, assigns = jax.lax.scan(step, centers, None, length=iters)
+        return assigns[-1]
+
+    return run
+
+
+class Superpixel:
+    """Cluster an image (H, W, C) into superpixels; returns a label map."""
+
+    @staticmethod
+    def cluster(image: np.ndarray, cell_size: float = 16.0,
+                modifier: float = 130.0, iters: int = 10) -> np.ndarray:
+        import jax.numpy as jnp
+
+        img = np.asarray(image, np.float32)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        h, w, c = img.shape
+        ys, xs = np.mgrid[0:h, 0:w].astype(np.float32)
+        rgb = img[:, :, :3] if c >= 3 else np.repeat(img, 3, axis=2)
+        feats = np.concatenate(
+            [ys[..., None], xs[..., None], rgb], axis=2).reshape(-1, 5)
+
+        gy = max(1, int(round(h / cell_size)))
+        gx = max(1, int(round(w / cell_size)))
+        cy = (np.arange(gy) + 0.5) * h / gy
+        cx = (np.arange(gx) + 0.5) * w / gx
+        centers = np.zeros((gy * gx, 5), np.float32)
+        k = 0
+        for yy in cy:
+            for xx in cx:
+                centers[k, 0], centers[k, 1] = yy, xx
+                centers[k, 2:] = rgb[int(yy), int(xx)]
+                k += 1
+        # spatial weight: (modifier / cellSize)^2 as in SLIC's m/S compactness
+        weight = (modifier / 100.0) * (1.0 / cell_size) ** 2 * 3.0
+        assign = _slic_kernel()(jnp.asarray(feats), jnp.asarray(centers),
+                                weight, iters)
+        return np.asarray(assign).reshape(h, w)
+
+    @staticmethod
+    def get_clusters(label_map: np.ndarray) -> List[List[tuple]]:
+        """Cluster id -> list of (x, y) pixels, parity with
+        SuperpixelData.clusters."""
+        out: Dict[int, List[tuple]] = {}
+        h, w = label_map.shape
+        for y in range(h):
+            for x in range(w):
+                out.setdefault(int(label_map[y, x]), []).append((x, y))
+        return [out[k] for k in sorted(out)]
+
+    @staticmethod
+    def mask_image(image: np.ndarray, label_map: np.ndarray,
+                   states: np.ndarray) -> np.ndarray:
+        """Zero out superpixels whose state is 0 (Superpixel.maskImage)."""
+        keep = np.asarray(states)[label_map]  # (h, w) 0/1
+        return np.asarray(image) * keep[..., None]
+
+
+class SuperpixelTransformer(Transformer, HasInputCol, HasOutputCol):
+    cellSize = Param("cellSize", "approximate superpixel cell size (px)",
+                     to_float, gt(0), default=16.0)
+    modifier = Param("modifier", "SLIC compactness modifier", to_float, gt(0),
+                     default=130.0)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        if not self.is_set("outputCol"):
+            self._paramMap["outputCol"] = "superpixels"
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        col = dataset.col(self.get("inputCol"))
+        out = np.empty(len(col), dtype=object)
+        for i, img in enumerate(col):
+            labels = Superpixel.cluster(np.asarray(img),
+                                        self.get("cellSize"),
+                                        self.get("modifier"))
+            out[i] = labels
+        return dataset.with_column(self.get("outputCol"), out)

@@ -1,0 +1,15 @@
+"""Isolation-forest anomaly detection.
+
+Parity surface: reference ``isolationforest`` module
+(isolationforest/IsolationForest.scala:19-41), which wraps LinkedIn's
+JVM isolation-forest. Here the ensemble is built natively: host-side
+randomized construction (cheap, ψ≤256 samples/tree), device-side
+scoring as a vmapped fixed-depth traversal (SURVEY.md §2.7).
+"""
+
+from mmlspark_tpu.isolationforest.iforest import (
+    IsolationForest,
+    IsolationForestModel,
+)
+
+__all__ = ["IsolationForest", "IsolationForestModel"]

@@ -476,7 +476,7 @@ class LightGBMRanker(_LightGBMBase):
         eval_at = self.get("evalAt") or [5]
         result, mapper, measures = self._fit_booster(
             df, "lambdarank", group_col=self.get("groupCol"),
-            extra_cfg={"eval_at": int(eval_at[0])})
+            extra_cfg={"eval_at": tuple(int(p) for p in eval_at)})
         model = LightGBMRankerModel(
             **{k: v for k, v in self._paramMap.items()
                if LightGBMRankerModel.has_param(k)})

@@ -75,7 +75,7 @@ class TrainConfig:
     fair_c: float = 1.0
     early_stopping_round: int = 0
     metric: Optional[str] = None
-    eval_at: int = 5              # NDCG@k position (first evalAt entry)
+    eval_at: Any = 5              # NDCG@k position(s): int or list of ints
     seed: int = 0
     deterministic: bool = True
     boost_from_average: bool = True
@@ -301,10 +301,11 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
 
     objective_fn = custom_objective or obj_mod.get_objective(cfg.objective)
     obj_kwargs = _objective_kwargs(cfg)
+    group_ids_dev = None if group_ids is None else jnp.asarray(group_ids)
     if cfg.objective == "lambdarank":
-        if group_ids is None:
+        if group_ids_dev is None:
             raise ValueError("lambdarank requires group_ids")
-        obj_kwargs = {"group_ids": jnp.asarray(group_ids), "sigmoid": cfg.sigmoid}
+        obj_kwargs = {"group_ids": group_ids_dev, "sigmoid": cfg.sigmoid}
 
     with measures.phase("dataPreparation"):
         if init_model is not None:
@@ -369,10 +370,18 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
         })
 
     metric_name = cfg.metric or metrics_mod.default_metric(cfg.objective)
-    if metric_name == "ndcg" and cfg.eval_at != 5:
-        metric_fn, higher_better = metrics_mod.ndcg_at(cfg.eval_at), True
+    if metric_name == "ndcg":
+        # one metric per requested position (LightGBM's eval_at list);
+        # early stopping follows the FIRST position, as the reference's
+        # first-metric early stop does (TrainUtils.scala:143-169)
+        positions = cfg.eval_at if isinstance(cfg.eval_at, (list, tuple)) \
+            else [cfg.eval_at]
+        metric_list = [(f"ndcg@{p}", metrics_mod.ndcg_at(int(p)))
+                       for p in positions]
+        higher_better = True
     else:
         metric_fn, higher_better = metrics_mod.METRICS[metric_name]
+        metric_list = [(metric_name, metric_fn)]
     # evaluate with the same objective params we train with
     # (TrainUtils.scala evals via the booster's own config): quantile's
     # pinball alpha must match cfg.alpha, not the metric default
@@ -498,27 +507,28 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
         # ----- eval + early stopping -------------------------------------
         with measures.phase("validation"):
             record: Dict[str, float] = {"iteration": it}
-            mkw = dict(metric_kwargs)
-            if metric_name == "ndcg" and group_ids is not None:
-                mkw["group_ids"] = jnp.asarray(group_ids)
-            record[f"train_{metric_name}"] = float(
-                metric_fn(raw, labels_d, weights_d, **mkw))
-            for vi, vs in enumerate(valid_states):
-                vkw = dict(metric_kwargs)
-                if metric_name == "ndcg":
-                    if vs["group_ids"] is None:
-                        raise ValueError(
-                            f"valid set {vi}: ndcg eval requires its own "
-                            f"group ids (pass 4-tuples in valid_sets)")
-                    vkw["group_ids"] = vs["group_ids"]
-                record[f"valid{vi}_{metric_name}"] = float(
-                    metric_fn(vs["raw"], vs["labels"], vs["weights"], **vkw))
+            for m_label, m_fn in metric_list:
+                mkw = dict(metric_kwargs)
+                if metric_name == "ndcg" and group_ids_dev is not None:
+                    mkw["group_ids"] = group_ids_dev
+                record[f"train_{m_label}"] = float(
+                    m_fn(raw, labels_d, weights_d, **mkw))
+                for vi, vs in enumerate(valid_states):
+                    vkw = dict(metric_kwargs)
+                    if metric_name == "ndcg":
+                        if vs["group_ids"] is None:
+                            raise ValueError(
+                                f"valid set {vi}: ndcg eval requires its own "
+                                f"group ids (pass 4-tuples in valid_sets)")
+                        vkw["group_ids"] = vs["group_ids"]
+                    record[f"valid{vi}_{m_label}"] = float(
+                        m_fn(vs["raw"], vs["labels"], vs["weights"], **vkw))
             evals.append(record)
         for cb in (callbacks or []):
             cb(it, record)
 
         if cfg.early_stopping_round > 0 and valid_states:
-            cur = record[f"valid0_{metric_name}"]
+            cur = record[f"valid0_{metric_list[0][0]}"]
             improved = cur > best_val if higher_better else cur < best_val
             if improved:
                 best_val, best_iter, rounds_no_improve = cur, it, 0

@@ -22,3 +22,7 @@ from mmlspark_tpu.models.vw.policyeval import (  # noqa: F401
     ips,
     snips,
 )
+from mmlspark_tpu.models.vw.cse import (  # noqa: F401
+    VowpalWabbitCSETransformer,
+    VowpalWabbitDSJsonTransformer,
+)

@@ -1,0 +1,149 @@
+"""DSJson decoding + counterfactual success experimentation (CSE).
+
+Parity: vw/.../VowpalWabbitDSJsonTransformer.scala:17 (decision-service
+json lines -> columns: EventId, probabilityLogged, chosenActionIndex,
+rewards struct, probabilities/actions arrays) and
+VowpalWabbitCSETransformer.scala:18 (per-stratum counterfactual metrics:
+importance-weight stats + IPS/SNIPS/CressieRead(+interval) per reward
+column, importance weight clipped to [minImportanceWeight,
+maxImportanceWeight]).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (
+    HasInputCol, Param, Params, ge, to_float, to_list, to_str,
+)
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.models.vw.policyeval import (
+    cressie_read,
+    cressie_read_interval,
+    ips,
+    snips,
+)
+
+
+class VowpalWabbitDSJsonTransformer(Transformer):
+    dsJsonColumn = Param("dsJsonColumn", "column of dsjson strings", to_str,
+                         default="value")
+    rewards = Param("rewards", "alias -> json field map for rewards",
+                    is_complex=True, default={"reward": "_label_cost"})
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        lines = dataset.col(self.get("dsJsonColumn"))
+        rewards_map = dict(self.get("rewards"))
+        n = len(lines)
+        event_ids = np.empty(n, dtype=object)
+        prob_logged = np.zeros(n)
+        chosen_idx = np.zeros(n, np.int64)
+        probabilities = np.empty(n, dtype=object)
+        actions = np.empty(n, dtype=object)
+        reward_cols: Dict[str, np.ndarray] = {
+            alias: np.zeros(n) for alias in rewards_map}
+        for i, line in enumerate(lines):
+            doc = json.loads(line)
+            event_ids[i] = doc.get("EventId", "")
+            prob_logged[i] = float(doc.get("_label_probability", 0.0))
+            # dsjson actions are 1-based with the chosen action first
+            acts = doc.get("_labelIndex", None)
+            chosen_idx[i] = int(acts) if acts is not None \
+                else int(doc.get("_label_Action", 1)) - 1
+            probabilities[i] = list(doc.get("p", []))
+            actions[i] = list(doc.get("a", []))
+            for alias, field in rewards_map.items():
+                v = doc.get(field, 0.0)
+                # _label_cost is a cost: reward = -cost, as the reference's
+                # downstream consumers negate it
+                reward_cols[alias][i] = float(v)
+        out = dataset.with_columns({
+            "EventId": event_ids,
+            "probabilityLogged": prob_logged,
+            "chosenActionIndex": chosen_idx,
+            "probabilities": probabilities,
+            "actions": actions,
+        })
+        reward_struct = np.empty(n, dtype=object)
+        for i in range(n):
+            reward_struct[i] = {alias: float(reward_cols[alias][i])
+                                for alias in rewards_map}
+        return out.with_column("rewards", reward_struct)
+
+
+class VowpalWabbitCSETransformer(Transformer):
+    minImportanceWeight = Param("minImportanceWeight",
+                                "importance-weight lower clip", to_float,
+                                ge(0), default=0.0)
+    maxImportanceWeight = Param("maxImportanceWeight",
+                                "importance-weight upper clip", to_float,
+                                ge(0), default=100.0)
+    metricsStratificationCols = Param("metricsStratificationCols",
+                                      "stratify metrics by these columns",
+                                      to_list(to_str), default=[])
+
+    def _metrics(self, sub: DataFrame) -> Dict[str, Any]:
+        p_log = np.asarray(sub.col("probabilityLogged"), np.float64)
+        p_pred = np.asarray(sub.col("probabilityPredicted"), np.float64)
+        # diagnostics are computed on RAW importance weights — the clip
+        # bounds apply inside the estimators only, as in the reference
+        # (raw w stats, clipped w in CressieRead/Interval)
+        w = p_pred / np.maximum(p_log, 1e-12)
+        out: Dict[str, Any] = {
+            "exampleCount": float(len(w)),
+            "probabilityPredictedNonZeroCount": float((p_pred > 0).sum()),
+            "minimumImportanceWeight": float(w.min()) if len(w) else 0.0,
+            "maximumImportanceWeight": float(w.max()) if len(w) else 0.0,
+            "averageImportanceWeight": float(w.mean()) if len(w) else 0.0,
+            "averageSquaredImportanceWeight": float((w ** 2).mean())
+            if len(w) else 0.0,
+            "proportionOfMaximumImportanceWeight":
+                float(w.max() / max(len(w), 1)) if len(w) else 0.0,
+            "quantilesOfImportanceWeight":
+                np.quantile(w, [0.25, 0.5, 0.75, 0.95]).tolist()
+                if len(w) else [],
+        }
+        rewards = sub.col("rewards")
+        aliases = list(rewards[0].keys()) if len(rewards) else []
+        w_min = self.get("minImportanceWeight")
+        w_max = self.get("maxImportanceWeight")
+        for alias in aliases:
+            r = np.asarray([d[alias] for d in rewards], np.float64)
+            # per-column reward range bounds the interval search, as the
+            # reference's min_reward/max_reward aggregates do
+            r_lo = float(r.min()) if len(r) else 0.0
+            r_hi = float(r.max()) if len(r) else 1.0
+            if r_hi <= r_lo:
+                r_hi = r_lo + 1.0
+            lo, hi = cressie_read_interval(p_log, r, p_pred,
+                                           reward_min=r_lo, reward_max=r_hi,
+                                           w_min=w_min, w_max=w_max)
+            out[f"{alias}_ips"] = ips(p_log, r, p_pred,
+                                      w_min=w_min, w_max=w_max)
+            out[f"{alias}_snips"] = snips(p_log, r, p_pred,
+                                          w_min=w_min, w_max=w_max)
+            out[f"{alias}_cressieRead"] = cressie_read(
+                p_log, r, p_pred, w_min=w_min, w_max=w_max)
+            out[f"{alias}_cressieReadIntervalLow"] = lo
+            out[f"{alias}_cressieReadIntervalHigh"] = hi
+        return out
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        strat = self.get("metricsStratificationCols")
+        if not strat:
+            return DataFrame.from_rows([self._metrics(dataset)])
+        # composite stratification key
+        keys = [" | ".join(str(dataset.col(c)[i]) for c in strat)
+                for i in range(dataset.num_rows)]
+        tmp = dataset.with_column("__stratum__", np.asarray(keys,
+                                                            dtype=object))
+        rows = []
+        for key, idx in tmp.group_indices("__stratum__").items():
+            m = self._metrics(dataset.take_rows(idx))
+            m["stratum"] = key
+            rows.append(m)
+        return DataFrame.from_rows(rows)

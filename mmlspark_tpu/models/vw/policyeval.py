@@ -39,34 +39,43 @@ class KahanSum:
         return self.sum + self._c
 
 
-def _ratios(prob_logged, reward, prob_pred, count=None):
+def _ratios(prob_logged, reward, prob_pred, count=None,
+            w_min=None, w_max=None):
     prob_logged = np.asarray(prob_logged, dtype=np.float64)
     reward = np.asarray(reward, dtype=np.float64)
     prob_pred = np.asarray(prob_pred, dtype=np.float64)
     count = (np.ones_like(reward) if count is None
              else np.asarray(count, dtype=np.float64))
     w = prob_pred / np.maximum(prob_logged, 1e-12)
+    if w_min is not None or w_max is not None:
+        # importance-weight clip INSIDE the estimator, as the reference
+        # passes its min/max bounds into CressieRead/Interval
+        w = np.clip(w, w_min if w_min is not None else -np.inf,
+                    w_max if w_max is not None else np.inf)
     return w, reward, count
 
 
-def ips(prob_logged, reward, prob_pred, count=None) -> float:
+def ips(prob_logged, reward, prob_pred, count=None,
+        w_min=None, w_max=None) -> float:
     """Inverse propensity score estimate (Ips.scala:1)."""
-    w, r, c = _ratios(prob_logged, reward, prob_pred, count)
+    w, r, c = _ratios(prob_logged, reward, prob_pred, count, w_min, w_max)
     return float(np.sum(w * r * c) / np.maximum(np.sum(c), 1e-12))
 
 
-def snips(prob_logged, reward, prob_pred, count=None) -> float:
+def snips(prob_logged, reward, prob_pred, count=None,
+          w_min=None, w_max=None) -> float:
     """Self-normalized IPS (Snips.scala:1)."""
-    w, r, c = _ratios(prob_logged, reward, prob_pred, count)
+    w, r, c = _ratios(prob_logged, reward, prob_pred, count, w_min, w_max)
     denom = np.sum(w * c)
     return float(np.sum(w * r * c) / np.maximum(denom, 1e-12))
 
 
-def cressie_read(prob_logged, reward, prob_pred, count=None) -> float:
+def cressie_read(prob_logged, reward, prob_pred, count=None,
+                 w_min=None, w_max=None) -> float:
     """Cressie-Read power-divergence estimator (CressieRead.scala:1):
     solves for the dual weights that minimize chi-square divergence
     subject to the importance-weight moment constraint."""
-    w, r, c = _ratios(prob_logged, reward, prob_pred, count)
+    w, r, c = _ratios(prob_logged, reward, prob_pred, count, w_min, w_max)
     n = np.sum(c)
     wsum = np.sum(w * c)
     w2sum = np.sum(w * w * c)
@@ -83,13 +92,14 @@ def cressie_read(prob_logged, reward, prob_pred, count=None) -> float:
 def cressie_read_interval(prob_logged, reward, prob_pred, count=None,
                           alpha: float = 0.05,
                           reward_min: float = 0.0,
-                          reward_max: float = 1.0) -> Tuple[float, float]:
+                          reward_max: float = 1.0,
+                          w_min=None, w_max=None) -> Tuple[float, float]:
     """Empirical-likelihood confidence interval for the CR estimate
     (CressieReadInterval.scala:1): bisection on the reward bound whose
     chi-square statistic crosses the (1-alpha) quantile."""
     from scipy.stats import chi2
 
-    w, r, c = _ratios(prob_logged, reward, prob_pred, count)
+    w, r, c = _ratios(prob_logged, reward, prob_pred, count, w_min, w_max)
     n = max(np.sum(c), 1.0)
     crit = chi2.ppf(1 - alpha, df=1) / (2 * n)
 
@@ -102,7 +112,8 @@ def cressie_read_interval(prob_logged, reward, prob_pred, count=None,
             return 0.0 if abs(zbar) < 1e-9 else np.inf
         return zbar * zbar / (2 * zvar)
 
-    center = cressie_read(prob_logged, reward, prob_pred, count)
+    center = cressie_read(prob_logged, reward, prob_pred, count,
+                          w_min=w_min, w_max=w_max)
     center = min(max(center, reward_min), reward_max)
 
     def bisect(lo, hi, target_low: bool):

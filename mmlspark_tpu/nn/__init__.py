@@ -1,0 +1,12 @@
+"""Exact (conditional) nearest neighbors.
+
+Parity surface: reference ``nn`` package (nn/BallTree.scala:109,
+nn/KNN.scala:49, nn/ConditionalKNN.scala:32). Matching is by **maximum
+inner product** as in the reference's ``findMaximumInnerProducts``.
+"""
+
+from mmlspark_tpu.nn.balltree import BallTree, BestMatch, ConditionalBallTree
+from mmlspark_tpu.nn.knn import KNN, ConditionalKNN, ConditionalKNNModel, KNNModel
+
+__all__ = ["BallTree", "ConditionalBallTree", "BestMatch",
+           "KNN", "KNNModel", "ConditionalKNN", "ConditionalKNNModel"]

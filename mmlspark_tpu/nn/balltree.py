@@ -1,0 +1,141 @@
+"""Serializable ball tree for maximum-inner-product search.
+
+Parity: nn/BallTree.scala:109 (BallTree), :203 (ConditionalBallTree) —
+a binary space partition over the *keys* with per-node bounding balls;
+queries return the top-k **inner products** (BestMatch(index, distance)).
+
+TPU-first note: the tree exists for host-side parity and small
+single-query use; the batch path used by the KNN transformer
+(:mod:`mmlspark_tpu.nn.knn`) is a dense ``Q @ K.T`` + ``lax.top_k`` on
+device — MXU-shaped, no tree traversal. The ball-bound pruning math
+(mu + r*|q| upper bound) matches the reference's traversal order.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence, Set
+
+import numpy as np
+
+
+@dataclass(order=True)
+class BestMatch:
+    distance: float  # inner product (higher = better), name kept for parity
+    index: int = field(compare=False)
+
+
+class _Node:
+    __slots__ = ("center", "radius", "lo", "hi", "left", "right")
+
+    def __init__(self, center, radius, lo, hi, left=None, right=None):
+        self.center = center
+        self.radius = radius
+        self.lo = lo          # [lo, hi) range into the permuted index array
+        self.hi = hi
+        self.left = left
+        self.right = right
+
+    @property
+    def is_leaf(self):
+        return self.left is None
+
+
+class BallTree:
+    """Ball tree over ``keys`` (n, d); ``values[i]`` is returned payload."""
+
+    def __init__(self, keys: np.ndarray, values: Sequence[Any],
+                 leaf_size: int = 50):
+        keys = np.asarray(keys, dtype=np.float64)
+        if keys.ndim != 2:
+            raise ValueError("keys must be (n, d)")
+        self.keys = keys
+        self.values = list(values)
+        self.leaf_size = int(leaf_size)
+        self._perm = np.arange(len(keys))
+        self._root = self._build(0, len(keys))
+
+    # -- construction (farthest-point split, as the reference's
+    # BallTreeBase.upperSplit/lowerSplit pivoting) ---------------------------
+    def _build(self, lo: int, hi: int) -> _Node:
+        idx = self._perm[lo:hi]
+        pts = self.keys[idx]
+        center = pts.mean(axis=0)
+        radius = float(np.sqrt(((pts - center) ** 2).sum(axis=1).max())) \
+            if len(pts) else 0.0
+        node = _Node(center, radius, lo, hi)
+        if hi - lo <= self.leaf_size:
+            return node
+        # pick the dimension-spanning pivot pair: farthest point from the
+        # first point, then farthest from that
+        a = pts[0]
+        d_a = ((pts - a) ** 2).sum(axis=1)
+        p1 = pts[int(np.argmax(d_a))]
+        d_p1 = ((pts - p1) ** 2).sum(axis=1)
+        p2 = pts[int(np.argmax(d_p1))]
+        d_p2 = ((pts - p2) ** 2).sum(axis=1)
+        closer_p1 = d_p1 < d_p2
+        if closer_p1.all() or (~closer_p1).all():  # degenerate: split evenly
+            closer_p1 = np.arange(len(pts)) < len(pts) // 2
+        order = np.argsort(~closer_p1, kind="stable")  # p1-side first
+        self._perm[lo:hi] = idx[order]
+        mid = lo + int(closer_p1.sum())
+        node.left = self._build(lo, mid)
+        node.right = self._build(mid, hi)
+        return node
+
+    # -- query ---------------------------------------------------------------
+    def _upper_bound(self, node: _Node, q: np.ndarray, qnorm: float) -> float:
+        # max_{x in ball} <q, x> = <q, c> + r * |q|
+        return float(q @ node.center) + node.radius * qnorm
+
+    def find_maximum_inner_products(self, query: np.ndarray, k: int = 1,
+                                    conditioner: Optional[Set[Any]] = None,
+                                    labels: Optional[Sequence[Any]] = None
+                                    ) -> List[BestMatch]:
+        q = np.asarray(query, dtype=np.float64)
+        qnorm = float(np.linalg.norm(q))
+        heap: List[BestMatch] = []  # min-heap on inner product
+
+        def admit(i: int) -> bool:
+            return conditioner is None or labels[i] in conditioner
+
+        def visit(node: _Node):
+            if len(heap) == k and self._upper_bound(node, q, qnorm) <= heap[0].distance:
+                return  # prune: ball can't beat current worst
+            if node.is_leaf:
+                for i in self._perm[node.lo:node.hi]:
+                    if not admit(i):
+                        continue
+                    ip = float(q @ self.keys[i])
+                    if len(heap) < k:
+                        heapq.heappush(heap, BestMatch(ip, int(i)))
+                    elif ip > heap[0].distance:
+                        heapq.heapreplace(heap, BestMatch(ip, int(i)))
+                return
+            ub_l = self._upper_bound(node.left, q, qnorm)
+            ub_r = self._upper_bound(node.right, q, qnorm)
+            first, second = (node.left, node.right) if ub_l >= ub_r \
+                else (node.right, node.left)
+            visit(first)
+            visit(second)
+
+        visit(self._root)
+        return sorted(heap, key=lambda m: -m.distance)
+
+
+class ConditionalBallTree(BallTree):
+    """BallTree whose points carry labels; queries restrict matches to a
+    conditioner label set (nn/BallTree.scala:203)."""
+
+    def __init__(self, keys: np.ndarray, values: Sequence[Any],
+                 labels: Sequence[Any], leaf_size: int = 50):
+        super().__init__(keys, values, leaf_size)
+        self.labels = list(labels)
+
+    def find_maximum_inner_products(self, query: np.ndarray,
+                                    conditioner: Set[Any], k: int = 1
+                                    ) -> List[BestMatch]:
+        return super().find_maximum_inner_products(
+            query, k, conditioner=conditioner, labels=self.labels)

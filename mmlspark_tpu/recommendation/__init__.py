@@ -1,0 +1,17 @@
+"""Recommendation: SAR + ranking adapters/evaluation.
+
+Parity surface: reference ``recommendation`` package
+(recommendation/SAR.scala:36, SARModel.scala:23, RankingAdapter.scala:1,
+RankingEvaluator.scala:1, RankingTrainValidationSplit.scala:1).
+"""
+
+from mmlspark_tpu.recommendation.ranking import (
+    RankingAdapter,
+    RankingAdapterModel,
+    RankingEvaluator,
+    RankingTrainValidationSplit,
+)
+from mmlspark_tpu.recommendation.sar import SAR, SARModel
+
+__all__ = ["SAR", "SARModel", "RankingAdapter", "RankingAdapterModel",
+           "RankingEvaluator", "RankingTrainValidationSplit"]

@@ -1,0 +1,221 @@
+"""Ranking adapter + evaluator + train/validation split.
+
+Parity: recommendation/RankingAdapter.scala:70 (wrap a recommender so
+generic evaluation sees per-user predicted item lists vs actual item
+lists), RankingEvaluator.scala:1 (map / ndcgAt / precisionAtk /
+recallAtK / mrr over (prediction, label) list pairs),
+RankingTrainValidationSplit.scala:1 (per-user stratified split + param
+grid search on a ranking metric).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (
+    Param, Params, gt, in_range, one_of, to_float, to_int, to_str,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model
+
+
+class _RankingParams(Params):
+    userCol = Param("userCol", "user column", to_str, default="user")
+    itemCol = Param("itemCol", "item column", to_str, default="item")
+    ratingCol = Param("ratingCol", "rating column", to_str, default="rating")
+    labelCol = Param("labelCol", "actual-items column", to_str, default="label")
+    k = Param("k", "recommendation list length", to_int, gt(0), default=10)
+
+
+class RankingAdapter(Estimator, _RankingParams):
+    recommender = Param("recommender", "wrapped recommender estimator",
+                        is_complex=True)
+    mode = Param("mode", "recommendation mode", to_str, one_of("allUsers"),
+                 default="allUsers")
+    minRatingsPerUser = Param("minRatingsPerUser", "min ratings per user",
+                              to_int, gt(0), default=1)
+    minRatingsPerItem = Param("minRatingsPerItem", "min ratings per item",
+                              to_int, gt(0), default=1)
+
+    def _fit(self, dataset: DataFrame) -> "RankingAdapterModel":
+        rec_model = self.get("recommender").fit(dataset)
+        model = RankingAdapterModel(
+            **{p.name: v for p, v in self.iter_set_params()
+               if p.name != "recommender"})
+        model._set(recommenderModel=rec_model)
+        return model
+
+
+class RankingAdapterModel(Model, _RankingParams):
+    """transform(df) → one row per user: ``prediction`` (recommended item
+    list) and ``label`` (actual items, rating-desc) —
+    RankingAdapter.scala:132-151."""
+
+    recommenderModel = Param("recommenderModel", "fitted recommender",
+                             is_complex=True)
+    mode = Param("mode", "recommendation mode", to_str, default="allUsers")
+    minRatingsPerUser = Param("minRatingsPerUser", "min ratings per user",
+                              to_int, default=1)
+    minRatingsPerItem = Param("minRatingsPerItem", "min ratings per item",
+                              to_int, default=1)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        rec = self.get("recommenderModel")
+        k = self.get("k")
+        recs = rec.recommend_for_user_subset(dataset, k)
+        user_col, item_col = self.get("userCol"), self.get("itemCol")
+        rating_col = self.get("ratingCol")
+
+        pred_of: Dict[Any, List[Any]] = {}
+        for row in recs.iter_rows():
+            pred_of[row[user_col]] = [m["item"] for m in row["recommendations"]]
+
+        ratings = dataset.col(rating_col) if rating_col in dataset else \
+            np.ones(dataset.num_rows)
+        items = dataset.col(item_col)
+        actual_of: Dict[Any, List[Tuple[float, Any]]] = {}
+        for u, it, r in zip(dataset.col(user_col), items, ratings):
+            actual_of.setdefault(u, []).append((-float(r), it))
+
+        users = sorted(actual_of.keys())
+        preds = np.empty(len(users), dtype=object)
+        actuals = np.empty(len(users), dtype=object)
+        for i, u in enumerate(users):
+            preds[i] = list(pred_of.get(u, []))
+            actuals[i] = [it for _, it in sorted(actual_of[u])]
+        return DataFrame({user_col: np.asarray(users),
+                          "prediction": preds, self.get("labelCol"): actuals})
+
+
+class RankingEvaluator(Params):
+    """Metrics over per-user (predicted list, actual list) pairs."""
+
+    metricName = Param("metricName", "ndcgAt|map|precisionAtk|recallAtK|mrr",
+                       to_str, one_of("ndcgAt", "map", "precisionAtk",
+                                      "recallAtK", "mrr"),
+                       default="ndcgAt")
+    k = Param("k", "cutoff", to_int, gt(0), default=10)
+    labelCol = Param("labelCol", "actual-items column", to_str, default="label")
+    predictionCol = Param("predictionCol", "predicted-items column", to_str,
+                          default="prediction")
+
+    def _pairs(self, dataset: DataFrame):
+        preds = dataset.col(self.get("predictionCol"))
+        labels = dataset.col(self.get("labelCol"))
+        return [(list(p), list(l)) for p, l in zip(preds, labels) if len(l)]
+
+    def evaluate(self, dataset: DataFrame) -> float:
+        return self.match_metric(self.get("metricName"), dataset)
+
+    def match_metric(self, name: str, dataset: DataFrame) -> float:
+        pairs = self._pairs(dataset)
+        if not pairs:
+            return 0.0
+        k = self.get("k")
+        vals = []
+        for pred, actual in pairs:
+            actual_set = set(actual)
+            if name == "ndcgAt":
+                dcg = sum(1.0 / np.log2(i + 2)
+                          for i, p in enumerate(pred[:k]) if p in actual_set)
+                idcg = sum(1.0 / np.log2(i + 2)
+                           for i in range(min(k, len(actual))))
+                vals.append(dcg / idcg if idcg > 0 else 0.0)
+            elif name == "map":
+                hits, score = 0, 0.0
+                for i, p in enumerate(pred):
+                    if p in actual_set:
+                        hits += 1
+                        score += hits / (i + 1.0)
+                vals.append(score / len(actual))
+            elif name == "precisionAtk":
+                vals.append(len(set(pred[:k]) & actual_set) / float(k))
+            elif name == "recallAtK":
+                vals.append(len(set(pred[:k]) & actual_set)
+                            / float(len(actual)))
+            elif name == "mrr":
+                rank = next((i + 1 for i, p in enumerate(pred)
+                             if p in actual_set), None)
+                vals.append(1.0 / rank if rank else 0.0)
+            else:
+                raise ValueError(f"unknown metric {name!r}")
+        return float(np.mean(vals))
+
+    def get_all_metrics(self, dataset: DataFrame) -> Dict[str, float]:
+        return {m: self.match_metric(m, dataset)
+                for m in ("map", "ndcgAt", "precisionAtk", "recallAtK", "mrr")}
+
+    def is_larger_better(self) -> bool:
+        return True
+
+
+class RankingTrainValidationSplit(Estimator, _RankingParams):
+    """Per-user chronology-free stratified split + grid search.
+
+    Parity: RankingTrainValidationSplit.scala:1 — trainRatio split keeps
+    every user present in train; candidate estimators (or param maps)
+    are evaluated with RankingEvaluator on the validation half.
+    """
+
+    estimator = Param("estimator", "recommender estimator", is_complex=True)
+    estimatorParamMaps = Param("estimatorParamMaps", "list of param dicts",
+                               is_complex=True)
+    evaluator = Param("evaluator", "RankingEvaluator", is_complex=True)
+    trainRatio = Param("trainRatio", "fraction of each user's events in "
+                       "train", to_float, in_range(0.0, 1.0,
+                                                   lo_inclusive=False,
+                                                   hi_inclusive=False),
+                       default=0.75)
+    seed = Param("seed", "rng seed", to_int, default=0)
+
+    def split(self, dataset: DataFrame) -> Tuple[DataFrame, DataFrame]:
+        rng = np.random.default_rng(self.get("seed"))
+        groups = dataset.group_indices(self.get("userCol"))
+        train_idx, valid_idx = [], []
+        ratio = self.get("trainRatio")
+        for _, idx in groups.items():
+            perm = rng.permutation(idx)
+            n_train = max(1, int(round(len(idx) * ratio)))
+            train_idx.append(perm[:n_train])
+            valid_idx.append(perm[n_train:])
+        return (dataset.take_rows(np.concatenate(train_idx)),
+                dataset.take_rows(np.concatenate(valid_idx))
+                if any(len(v) for v in valid_idx)
+                else dataset.take_rows(np.asarray([], dtype=np.int64)))
+
+    def _fit(self, dataset: DataFrame) -> "RankingTrainValidationSplitModel":
+        train_df, valid_df = self.split(dataset)
+        evaluator = self.get("evaluator") or RankingEvaluator()
+        param_maps = self.get("estimatorParamMaps") or [{}]
+        base = self.get("estimator")
+
+        best_model, best_metric, metrics = None, -np.inf, []
+        for pm in param_maps:
+            adapter = RankingAdapter(
+                recommender=base.copy(**pm), k=self.get("k"),
+                userCol=self.get("userCol"), itemCol=self.get("itemCol"),
+                ratingCol=self.get("ratingCol"))
+            fitted = adapter.fit(train_df)
+            scored = fitted.transform(valid_df)
+            m = evaluator.evaluate(scored)
+            metrics.append(m)
+            if m > best_metric:
+                best_metric, best_model = m, fitted
+        out = RankingTrainValidationSplitModel()
+        out._set(bestModel=best_model)
+        out.validation_metrics = metrics
+        return out
+
+
+class RankingTrainValidationSplitModel(Model):
+    bestModel = Param("bestModel", "best fitted ranking adapter",
+                      is_complex=True)
+    validation_metrics: List[float] = []
+
+    def get_best_model(self) -> RankingAdapterModel:
+        return self.get("bestModel")
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        return self.get("bestModel").transform(dataset)

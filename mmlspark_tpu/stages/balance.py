@@ -111,6 +111,9 @@ class StratifiedRepartition(Transformer, HasLabelCol):
         # interleave labels round-robin so each contiguous shard gets all
         # labels (the RangePartitioner-on-index analog)
         order = np.concatenate(picked)
-        keys = np.concatenate([np.arange(len(p)) for p in picked])
+        # fractional position within each label group: labels interleave
+        # evenly, so every contiguous shard sees every label
+        keys = np.concatenate([np.arange(len(p)) / max(len(p), 1)
+                               for p in picked])
         out = dataset.take_rows(order[np.argsort(keys, kind="stable")])
         return out.with_metadata("__shards__", {"n": n_shards})

@@ -52,19 +52,21 @@ class Timer(Estimator):
 
 class TimerModel(Model):
     stage = Param("stage", "the owning Timer", is_complex=True)
-
-    fitted_stage: Transformer
+    fittedStage = Param("fittedStage", "the fitted inner stage",
+                        is_complex=True)
 
     def __init__(self, stage: Optional[Timer] = None, **kwargs: Any):
         super().__init__(**kwargs)
         if stage is not None:
             self._paramMap["stage"] = stage
 
-    def _get_state(self):
-        return {"fitted_stage": self.fitted_stage}
+    @property
+    def fitted_stage(self) -> Transformer:
+        return self.get("fittedStage")
 
-    def _set_state(self, state):
-        self.fitted_stage = state["fitted_stage"]
+    @fitted_stage.setter
+    def fitted_stage(self, value: Transformer) -> None:
+        self._paramMap["fittedStage"] = value
 
     def _transform(self, dataset: DataFrame) -> DataFrame:
         timer: Timer = self.get("stage")

@@ -1,0 +1,26 @@
+"""AutoML-lite train/eval: wrap any learner + metrics computation.
+
+Parity surface: the reference's ``train`` package
+(core/src/main/scala/.../train/TrainClassifier.scala:52,
+TrainRegressor.scala:1, ComputeModelStatistics.scala:58,
+ComputePerInstanceStatistics.scala:1).
+"""
+
+from mmlspark_tpu.train.statistics import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+    MetricConstants,
+)
+from mmlspark_tpu.train.trainers import (
+    TrainClassifier,
+    TrainedClassifierModel,
+    TrainedRegressorModel,
+    TrainRegressor,
+)
+
+__all__ = [
+    "TrainClassifier", "TrainRegressor",
+    "TrainedClassifierModel", "TrainedRegressorModel",
+    "ComputeModelStatistics", "ComputePerInstanceStatistics",
+    "MetricConstants",
+]

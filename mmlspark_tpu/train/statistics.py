@@ -1,0 +1,217 @@
+"""Model-quality metrics as pipeline stages.
+
+Parity: ``ComputeModelStatistics`` (reference
+core/src/main/scala/.../train/ComputeModelStatistics.scala:58) computes
+classification metrics (accuracy/precision/recall/AUC + confusion
+matrix) or regression metrics (mse/rmse/r2/mae) from a scored
+DataFrame; ``ComputePerInstanceStatistics`` (ComputePerInstanceStatistics.scala:1)
+emits per-row losses. Metric names follow the reference's
+``MetricConstants`` (core/metrics/MetricConstants.scala:7-40).
+
+TPU-first: the reductions are jit-compiled jnp; the confusion matrix is
+a one-hot matmul (MXU-friendly) rather than a per-row loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (
+    HasLabelCol, Param, one_of, to_str,
+)
+from mmlspark_tpu.core.pipeline import Transformer
+
+
+class MetricConstants:
+    # regression
+    Mse = "mse"
+    Rmse = "rmse"
+    R2 = "r2"
+    Mae = "mae"
+    RegressionMetricsName = "regression"
+    RegressionMetrics = {Mse, Rmse, R2, Mae, RegressionMetricsName}
+    # classification
+    Accuracy = "accuracy"
+    Precision = "precision"
+    Recall = "recall"
+    Auc = "AUC"
+    ClassificationMetricsName = "classification"
+    ClassificationMetrics = {Accuracy, Precision, Recall, Auc,
+                             ClassificationMetricsName}
+    AllSparkMetrics = "all"
+    ConfusionMatrix = "confusion_matrix"
+    EvaluationType = "evaluation_type"
+
+
+def _classification_metrics(labels: np.ndarray, preds: np.ndarray,
+                            scores: Optional[np.ndarray]) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models.gbdt.metrics import auc as auc_metric
+
+    classes = np.unique(np.concatenate([labels, preds]))
+    k = int(classes.max()) + 1 if len(classes) else 1
+    k = max(k, 2)
+
+    @jax.jit
+    def stats(y, p):
+        oh_y = jax.nn.one_hot(y.astype(jnp.int32), k)
+        oh_p = jax.nn.one_hot(p.astype(jnp.int32), k)
+        # confusion[i, j] = #(label==i, pred==j): one matmul on the MXU
+        confusion = oh_y.T @ oh_p
+        correct = jnp.trace(confusion)
+        total = jnp.sum(confusion)
+        accuracy = correct / jnp.maximum(total, 1.0)
+        tp = jnp.diag(confusion)
+        per_class_prec = tp / jnp.maximum(jnp.sum(confusion, axis=0), 1.0)
+        per_class_rec = tp / jnp.maximum(jnp.sum(confusion, axis=1), 1.0)
+        return confusion, accuracy, per_class_prec, per_class_rec
+
+    confusion, accuracy, prec_c, rec_c = stats(jnp.asarray(labels), jnp.asarray(preds))
+    confusion = np.asarray(confusion)
+    out: Dict[str, Any] = {
+        MetricConstants.Accuracy: float(accuracy),
+        MetricConstants.ConfusionMatrix: confusion,
+    }
+    if k == 2:
+        # binary: precision/recall on the positive class (reference uses
+        # Spark MulticlassMetrics.precision(1.0)/recall(1.0) semantics)
+        out[MetricConstants.Precision] = float(prec_c[1])
+        out[MetricConstants.Recall] = float(rec_c[1])
+        if scores is not None:
+            import jax.numpy as jnp
+            out[MetricConstants.Auc] = float(
+                auc_metric(jnp.asarray(scores), jnp.asarray(labels)))
+    else:
+        # multiclass: micro-averaged (== accuracy) + macro averages, as the
+        # reference's addAllClassificationMetrics does
+        # (ComputeModelStatistics.scala:234-247)
+        out[MetricConstants.Precision] = float(accuracy)
+        out[MetricConstants.Recall] = float(accuracy)
+        present = np.isin(np.arange(k), classes.astype(int))
+        out["average_accuracy"] = float(accuracy)
+        out["macro_averaged_precision"] = float(np.mean(np.asarray(prec_c)[present]))
+        out["macro_averaged_recall"] = float(np.mean(np.asarray(rec_c)[present]))
+    return out
+
+
+def _regression_metrics(labels: np.ndarray, preds: np.ndarray) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def stats(y, p):
+        err = p - y
+        mse = jnp.mean(err ** 2)
+        mae = jnp.mean(jnp.abs(err))
+        var = jnp.mean((y - jnp.mean(y)) ** 2)
+        r2 = 1.0 - mse / jnp.maximum(var, 1e-30)
+        return mse, jnp.sqrt(mse), r2, mae
+
+    mse, rmse, r2, mae = stats(jnp.asarray(labels), jnp.asarray(preds))
+    return {MetricConstants.Mse: float(mse), MetricConstants.Rmse: float(rmse),
+            MetricConstants.R2: float(r2), MetricConstants.Mae: float(mae)}
+
+
+class ComputeModelStatistics(Transformer, HasLabelCol):
+    """Compute classification/regression metrics from a scored DataFrame.
+
+    Returns a one-row DataFrame of metric columns, mirroring the
+    reference transform (ComputeModelStatistics.scala:75-166).
+    """
+
+    evaluationMetric = Param(
+        "evaluationMetric", "metric to compute: all|classification|regression"
+        "|accuracy|precision|recall|AUC|mse|rmse|r2|mae", to_str,
+        one_of("all", "classification", "regression", "accuracy", "precision",
+               "recall", "AUC", "mse", "rmse", "r2", "mae"),
+        default="all")
+    scoresCol = Param("scoresCol", "raw score / probability column for AUC",
+                      to_str)
+    scoredLabelsCol = Param("scoredLabelsCol", "predicted-label column", to_str,
+                            default="prediction")
+
+    def _infer_kind(self, labels: np.ndarray) -> str:
+        metric = self.get("evaluationMetric")
+        if metric in MetricConstants.RegressionMetrics and \
+                metric != MetricConstants.AllSparkMetrics:
+            return "regression"
+        if metric in MetricConstants.ClassificationMetrics:
+            return "classification"
+        # "all": infer from the label column the way the reference infers
+        # from schema categorical metadata — integer-valued small-cardinality
+        # labels are classification
+        as_int = labels.astype(np.int64, copy=False) if labels.dtype.kind in "iu" \
+            else None
+        if labels.dtype.kind in "iu":
+            return "classification"
+        if labels.dtype.kind == "f" and np.all(labels == np.round(labels)) \
+                and len(np.unique(labels)) <= 100:
+            return "classification"
+        del as_int
+        return "regression"
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        labels = np.asarray(dataset.col(self.get("labelCol")), dtype=np.float64)
+        preds = np.asarray(dataset.col(self.get("scoredLabelsCol")),
+                           dtype=np.float64)
+        kind = self._infer_kind(np.asarray(dataset.col(self.get("labelCol"))))
+        if kind == "regression":
+            metrics: Dict[str, Any] = _regression_metrics(labels, preds)
+        else:
+            scores = None
+            sc = self.get("scoresCol")
+            if sc and sc in dataset:
+                s = dataset.col(sc)
+                scores = np.asarray(s[:, -1] if s.ndim == 2 else s,
+                                    dtype=np.float64)
+            metrics = _classification_metrics(labels, preds, scores)
+            metrics[MetricConstants.EvaluationType] = "Classification"
+        want = self.get("evaluationMetric")
+        if want not in (MetricConstants.AllSparkMetrics,
+                        MetricConstants.ClassificationMetricsName,
+                        MetricConstants.RegressionMetricsName):
+            keep = {want, MetricConstants.ConfusionMatrix,
+                    MetricConstants.EvaluationType}
+            metrics = {k: v for k, v in metrics.items() if k in keep}
+        cols = {}
+        for k, v in metrics.items():
+            if isinstance(v, np.ndarray):
+                cell = np.empty(1, dtype=object)
+                cell[0] = v
+                cols[k] = cell
+            else:
+                cols[k] = np.asarray([v])
+        return DataFrame(cols)
+
+
+class ComputePerInstanceStatistics(Transformer, HasLabelCol):
+    """Per-row loss columns (L1/L2 for regression, log-loss for
+    classification), parity with ComputePerInstanceStatistics.scala:1."""
+
+    scoresCol = Param("scoresCol", "probability/score column", to_str)
+    scoredLabelsCol = Param("scoredLabelsCol", "predicted-label column", to_str,
+                            default="prediction")
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        labels = np.asarray(dataset.col(self.get("labelCol")), dtype=np.float64)
+        preds = np.asarray(dataset.col(self.get("scoredLabelsCol")),
+                           dtype=np.float64)
+        sc = self.get("scoresCol")
+        if sc and sc in dataset:
+            probs = dataset.col(sc)
+            if probs.ndim == 2:
+                idx = labels.astype(np.int64)
+                idx = np.clip(idx, 0, probs.shape[1] - 1)
+                p = probs[np.arange(len(labels)), idx]
+            else:
+                p = np.where(labels > 0, probs, 1.0 - probs)
+            logloss = -np.log(np.clip(p.astype(np.float64), 1e-15, 1.0))
+            return dataset.with_column("log_loss", logloss)
+        err = preds - labels
+        return dataset.with_columns({"L1_loss": np.abs(err),
+                                     "L2_loss": err ** 2})

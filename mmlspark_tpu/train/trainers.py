@@ -1,0 +1,154 @@
+"""TrainClassifier / TrainRegressor: auto-featurizing learner wrappers.
+
+Parity: reference ``TrainClassifier`` (train/TrainClassifier.scala:52)
+and ``TrainRegressor`` (train/TrainRegressor.scala:1) — featurize all
+non-label columns into one vector column, optionally reindex the label,
+fit the inner learner, and return a model that scores + maps indexed
+labels back (``TrainedClassifierModel.transform``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (
+    HasFeaturesCol, HasLabelCol, Param, to_bool, to_int, to_list, to_str,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+from mmlspark_tpu.featurize.featurize import Featurize
+from mmlspark_tpu.featurize.indexer import ValueIndexer
+
+
+class _AutoTrainer(Estimator, HasFeaturesCol, HasLabelCol):
+    """Shared base of TrainClassifier/TrainRegressor (AutoTrainer.scala:1)."""
+
+    model = Param("model", "inner learner to run", is_complex=True)
+    numFeatures = Param("numFeatures", "number of hashed features (0 = no "
+                        "hashing)", to_int, default=0)
+
+    def _featurize(self, dataset: DataFrame, feature_cols: List[str]) -> Transformer:
+        feat = Featurize(inputCols=feature_cols,
+                         outputCol=self.get("featuresCol"),
+                         numFeatures=self.get("numFeatures") or None)
+        return feat.fit(dataset)
+
+    def _feature_columns(self, dataset: DataFrame) -> List[str]:
+        label = self.get("labelCol")
+        return [c for c in dataset.columns if c != label]
+
+
+class TrainClassifier(_AutoTrainer):
+    """Featurize + (optionally) reindex labels + fit a classifier.
+
+    reindexLabel/labels interaction follows the reference contract
+    (TrainClassifier.scala:24-41).
+    """
+
+    reindexLabel = Param("reindexLabel", "re-index the label column", to_bool,
+                         default=True)
+    labels = Param("labels", "sorted label values for the label column",
+                   to_list(to_str))
+
+    def _fit(self, dataset: DataFrame) -> "TrainedClassifierModel":
+        label_col = self.get("labelCol")
+        levels: Optional[List[Any]] = None
+        df = dataset
+
+        labels_arr = df.col(label_col)
+        # drop rows with missing labels (convertLabel parity)
+        if labels_arr.dtype.kind == "f":
+            keep = ~np.isnan(labels_arr)
+            if not keep.all():
+                df = df.filter(keep)
+                labels_arr = df.col(label_col)
+
+        if self.is_set("labels"):
+            levels = list(self.get("labels"))
+            lookup = {v: i for i, v in enumerate(levels)}
+            idx = np.asarray([lookup[str(v)] for v in labels_arr], np.float64)
+            df = df.with_column(label_col, idx)
+        elif self.get("reindexLabel"):
+            indexer = ValueIndexer(inputCol=label_col, outputCol=label_col)
+            model = indexer.fit(df)
+            levels = list(model.levels)
+            df = model.transform(df)
+
+        feature_cols = self._feature_columns(dataset)
+        feat_model = self._featurize(df, feature_cols)
+        featurized = feat_model.transform(df)
+
+        inner = self.get("model")
+        if inner is None:
+            from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+            inner = LightGBMClassifier()
+        inner = inner.copy(featuresCol=self.get("featuresCol"),
+                           labelCol=label_col)
+        fitted = inner.fit(featurized)
+        return TrainedClassifierModel(
+            featuresCol=self.get("featuresCol"), labelCol=label_col,
+            )._init_state(feat_model, fitted, levels)
+
+
+class TrainRegressor(_AutoTrainer):
+    def _fit(self, dataset: DataFrame) -> "TrainedRegressorModel":
+        label_col = self.get("labelCol")
+        df = dataset
+        labels_arr = df.col(label_col)
+        if labels_arr.dtype.kind == "f":
+            keep = ~np.isnan(labels_arr)
+            if not keep.all():
+                df = df.filter(keep)
+
+        feature_cols = self._feature_columns(dataset)
+        feat_model = self._featurize(df, feature_cols)
+        featurized = feat_model.transform(df)
+
+        inner = self.get("model")
+        if inner is None:
+            from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+            inner = LightGBMRegressor()
+        inner = inner.copy(featuresCol=self.get("featuresCol"),
+                           labelCol=label_col)
+        fitted = inner.fit(featurized)
+        return TrainedRegressorModel(
+            featuresCol=self.get("featuresCol"), labelCol=label_col,
+            )._init_state(feat_model, fitted)
+
+
+class _TrainedBase(Model, HasFeaturesCol, HasLabelCol):
+    featurizer = Param("featurizer", "fitted featurization model",
+                       is_complex=True)
+    innerModel = Param("innerModel", "fitted inner model", is_complex=True)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        featurized = self.get("featurizer").transform(dataset)
+        return self.get("innerModel").transform(featurized)
+
+
+class TrainedClassifierModel(_TrainedBase):
+    levels = Param("levels", "original label values, index order",
+                   is_complex=True)
+
+    def _init_state(self, featurizer, inner, levels):
+        self._set(featurizer=featurizer, innerModel=inner, levels=levels)
+        return self
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        scored = super()._transform(dataset)
+        levels = self.get("levels")
+        if levels is not None:
+            pred_col = self.get("innerModel").get("predictionCol")
+            idx = np.asarray(scored.col(pred_col)).astype(np.int64)
+            idx = np.clip(idx, 0, len(levels) - 1)
+            mapped = np.asarray([levels[i] for i in idx])
+            scored = scored.with_column("scored_labels", mapped)
+        return scored
+
+
+class TrainedRegressorModel(_TrainedBase):
+    def _init_state(self, featurizer, inner):
+        self._set(featurizer=featurizer, innerModel=inner)
+        return self

@@ -1,0 +1,151 @@
+"""causal tests, patterned on the reference's VerifyDoubleMLEstimator /
+VerifySyntheticDiffInDiffEstimator suites."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.causal import (
+    DiffInDiffEstimator,
+    DoubleMLEstimator,
+    OrthoForestDMLEstimator,
+    ResidualTransformer,
+    SyntheticControlEstimator,
+    SyntheticDiffInDiffEstimator,
+    constrained_least_square,
+    mirror_descent,
+)
+from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+
+
+def _dml_data(n=600, effect=2.5, seed=0):
+    """Y = effect*T + confounding(X) + noise; T depends on X."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 3))
+    propensity = 1 / (1 + np.exp(-x[:, 0]))
+    t = (rng.random(n) < propensity).astype(np.float64)
+    y = effect * t + 2.0 * x[:, 0] + x[:, 1] + rng.normal(size=n) * 0.3
+    return DataFrame({"features": x, "treatment": t, "outcome": y})
+
+
+class TestMirrorDescent:
+    def test_simplex_solution(self):
+        # b is exactly A @ [0.3, 0.7]
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(50, 2))
+        w_true = np.asarray([0.3, 0.7])
+        b = a @ w_true
+        w = mirror_descent(a, b)
+        assert w.sum() == pytest.approx(1.0, abs=1e-5)
+        assert (w >= 0).all()
+        assert np.allclose(w, w_true, atol=0.01)
+
+    def test_constrained_with_intercept(self):
+        rng = np.random.default_rng(1)
+        a = rng.normal(size=(60, 3))
+        w_true = np.asarray([0.2, 0.5, 0.3])
+        b = a @ w_true + 4.0
+        w, c = constrained_least_square(a, b)
+        assert np.allclose(w, w_true, atol=0.02)
+        assert c == pytest.approx(4.0, abs=0.1)
+
+
+class TestDoubleML:
+    def test_recovers_effect(self):
+        df = _dml_data()
+        est = DoubleMLEstimator(
+            treatmentModel=LightGBMRegressor(numIterations=20, numLeaves=7),
+            outcomeModel=LightGBMRegressor(numIterations=20, numLeaves=7),
+            maxIter=1)
+        model = est.fit(df)
+        assert model.get_avg_treatment_effect() == pytest.approx(2.5, abs=0.5)
+
+    def test_bootstrap_ci_brackets_effect(self):
+        df = _dml_data(400)
+        est = DoubleMLEstimator(
+            treatmentModel=LightGBMRegressor(numIterations=10, numLeaves=7),
+            outcomeModel=LightGBMRegressor(numIterations=10, numLeaves=7),
+            maxIter=6, parallelism=2)
+        model = est.fit(df)
+        lo, hi = model.get_confidence_interval()
+        # generous slop: 6 bootstrap draws + underfit nuisance models bias
+        # the small-sample interval
+        assert lo - 0.7 < 2.5 < hi + 0.7
+        assert lo <= hi
+        assert len(model.get("rawTreatmentEffects")) == 6
+        assert model.get_pvalue() <= 0.5
+
+    def test_residual_transformer(self):
+        df = DataFrame({"obs": np.asarray([1.0, 2.0]),
+                        "pred": np.asarray([0.5, 2.5])})
+        out = ResidualTransformer(observedCol="obs", predictedCol="pred",
+                                  outputCol="res").transform(df)
+        assert np.allclose(out.col("res"), [0.5, -0.5])
+
+
+class TestOrthoForest:
+    def test_heterogeneous_effect_direction(self):
+        rng = np.random.default_rng(3)
+        n = 800
+        x = rng.normal(size=(n, 3))
+        h = rng.normal(size=(n, 1))  # heterogeneity driver
+        tau = np.where(h[:, 0] > 0, 3.0, 1.0)
+        t = (rng.random(n) < 1 / (1 + np.exp(-x[:, 0]))).astype(np.float64)
+        y = tau * t + x[:, 0] + rng.normal(size=n) * 0.3
+        df = DataFrame({"features": x, "heterogeneityVector": h,
+                        "treatment": t, "outcome": y})
+        est = OrthoForestDMLEstimator(
+            treatmentModel=LightGBMRegressor(numIterations=10, numLeaves=7),
+            outcomeModel=LightGBMRegressor(numIterations=10, numLeaves=7),
+            numTrees=10, maxDepth=3)
+        model = est.fit(df)
+        out = model.transform(df)
+        cate = out.col("EffectAverage")
+        hi_group = cate[h[:, 0] > 0.5].mean()
+        lo_group = cate[h[:, 0] < -0.5].mean()
+        assert hi_group > lo_group + 0.5
+        assert (out.col("EffectLowerBound") <= out.col("EffectUpperBound")).all()
+
+
+class TestDiffInDiff:
+    def test_two_by_two(self):
+        rng = np.random.default_rng(4)
+        n = 2000
+        treat = rng.integers(0, 2, n).astype(np.float64)
+        post = rng.integers(0, 2, n).astype(np.float64)
+        y = 1.0 + 0.5 * treat + 0.8 * post + 2.0 * treat * post \
+            + rng.normal(size=n) * 0.2
+        df = DataFrame({"treatment": treat, "postTreatment": post,
+                        "outcome": y})
+        model = DiffInDiffEstimator().fit(df)
+        assert model.treatment_effect == pytest.approx(2.0, abs=0.1)
+        assert model.standard_error < 0.05
+
+    def _panel(self, effect=3.0, seed=5):
+        rng = np.random.default_rng(seed)
+        units, times = 12, 10
+        unit_fe = rng.normal(size=units)
+        time_fe = np.linspace(0, 1, times)
+        rows = []
+        for u in range(units):
+            treated = u < 3
+            for t in range(times):
+                post = t >= 6
+                y = unit_fe[u] + time_fe[t] + rng.normal() * 0.05
+                if treated and post:
+                    y += effect
+                rows.append({"unit": u, "time": t, "outcome": y,
+                             "treatment": float(treated),
+                             "postTreatment": float(post)})
+        return DataFrame.from_rows(rows)
+
+    def test_synthetic_control(self):
+        model = SyntheticControlEstimator().fit(self._panel())
+        assert model.treatment_effect == pytest.approx(3.0, abs=0.5)
+        w = np.asarray(model.summary["unitWeights"])
+        assert w.sum() == pytest.approx(1.0, abs=1e-4)
+
+    def test_synthetic_diff_in_diff(self):
+        model = SyntheticDiffInDiffEstimator().fit(self._panel())
+        assert model.treatment_effect == pytest.approx(3.0, abs=0.4)
+        assert "timeWeights" in model.summary

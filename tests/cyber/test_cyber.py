@@ -1,0 +1,98 @@
+"""cyber tests, patterned on the reference's explore_access_anomalies /
+test_scalers / test_indexers python suites."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.cyber import (
+    AccessAnomaly,
+    ComplementAccessTransformer,
+    IdIndexer,
+    PartitionedMinMaxScaler,
+    PartitionedStandardScaler,
+)
+
+
+class TestFeature:
+    def test_id_indexer_per_partition(self):
+        df = DataFrame({"tenant": np.asarray(["a", "a", "b", "b", "b"],
+                                             dtype=object),
+                        "user": np.asarray(["u1", "u2", "u1", "u3", "u1"],
+                                           dtype=object)})
+        model = IdIndexer(inputCol="user", outputCol="uidx",
+                          partitionKey="tenant").fit(df)
+        out = model.transform(df)
+        # ids restart per tenant, 1-based
+        assert out.col("uidx").tolist() == [1, 2, 1, 2, 1]
+        back = model.undo_transform(out)
+        assert back.col("user").tolist() == ["u1", "u2", "u1", "u3", "u1"]
+
+    def test_standard_scaler_per_partition(self):
+        df = DataFrame({"t": np.asarray(["a"] * 3 + ["b"] * 3, dtype=object),
+                        "v": np.asarray([1.0, 2.0, 3.0, 10.0, 20.0, 30.0])})
+        model = PartitionedStandardScaler(inputCol="v", outputCol="z",
+                                          partitionKey="t").fit(df)
+        z = model.transform(df).col("z")
+        assert z[:3].mean() == pytest.approx(0.0, abs=1e-9)
+        assert z[3:].mean() == pytest.approx(0.0, abs=1e-9)
+
+    def test_minmax_scaler_range(self):
+        df = DataFrame({"v": np.asarray([1.0, 3.0, 5.0])})
+        model = PartitionedMinMaxScaler(inputCol="v", outputCol="s",
+                                        minRequiredValue=5.0,
+                                        maxRequiredValue=10.0).fit(df)
+        s = model.transform(df).col("s")
+        assert s.min() == pytest.approx(5.0)
+        assert s.max() == pytest.approx(10.0)
+
+
+class TestComplement:
+    def test_complement_avoids_observed(self):
+        rng = np.random.default_rng(0)
+        n = 60
+        df = DataFrame({"tenant": np.zeros(n, np.int64),
+                        "user_idx": rng.integers(1, 10, n),
+                        "res_idx": rng.integers(1, 10, n)})
+        seen = set(zip(df.col("user_idx").tolist(),
+                       df.col("res_idx").tolist()))
+        comp = ComplementAccessTransformer(
+            tenantCol="tenant", complementsetFactor=1).transform(df)
+        assert comp.num_rows > 0
+        for u, r in zip(comp.col("user_idx"), comp.col("res_idx")):
+            assert (u, r) not in seen
+
+
+class TestAccessAnomaly:
+    def test_cross_clique_access_is_anomalous(self):
+        """Users access resources in their own clique; an access across
+        cliques must score higher than in-clique accesses."""
+        rng = np.random.default_rng(1)
+        rows = []
+        for u in range(20):
+            clique = u % 2
+            for _ in range(12):
+                r = int(rng.integers(0, 10)) + clique * 10
+                rows.append({"tenant": 0, "user": f"u{u}", "res": f"r{r}",
+                             "likelihood": 1.0 + rng.random()})
+        df = DataFrame.from_rows(rows)
+        model = AccessAnomaly(maxIter=300, rankParam=8, seed=2).fit(df)
+
+        in_clique = DataFrame.from_rows(
+            [{"tenant": 0, "user": "u0", "res": "r3", "likelihood": 1.0},
+             {"tenant": 0, "user": "u1", "res": "r13", "likelihood": 1.0}])
+        cross = DataFrame.from_rows(
+            [{"tenant": 0, "user": "u0", "res": "r13", "likelihood": 1.0},
+             {"tenant": 0, "user": "u1", "res": "r3", "likelihood": 1.0}])
+        s_in = model.transform(in_clique).col("anomaly_score")
+        s_cross = model.transform(cross).col("anomaly_score")
+        assert s_cross.mean() > s_in.mean() + 0.5
+
+    def test_unseen_user_neutral(self):
+        rows = [{"tenant": 0, "user": f"u{i}", "res": "r0",
+                 "likelihood": 1.0} for i in range(5)]
+        model = AccessAnomaly(maxIter=50).fit(DataFrame.from_rows(rows))
+        out = model.transform(DataFrame.from_rows(
+            [{"tenant": 0, "user": "stranger", "res": "r0",
+              "likelihood": 1.0}]))
+        assert out.col("anomaly_score")[0] == 0.0

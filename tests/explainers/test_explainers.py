@@ -1,0 +1,203 @@
+"""explainers tests, patterned on the reference's split1/ LIME + SHAP +
+ICE suites (core/src/test/scala/.../explainers/)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.pipeline import Model, Transformer
+from mmlspark_tpu.explainers import (
+    ICETransformer,
+    LassoRegression,
+    LeastSquaresRegression,
+    TabularLIME,
+    TabularSHAP,
+    TextLIME,
+    TextSHAP,
+    VectorLIME,
+    VectorSHAP,
+)
+
+
+class _LinearModel(Transformer):
+    """Deterministic model: probability = sigmoid(w . x) on inputCols or a
+    vector column."""
+
+    def __init__(self, weights, cols=None, **kw):
+        super().__init__(**kw)
+        self.weights = np.asarray(weights, np.float64)
+        self.cols = cols
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        if self.cols:
+            x = np.stack([np.asarray(df.col(c), np.float64)
+                          for c in self.cols], axis=1)
+        else:
+            x = np.asarray(df.col("features"), np.float64)
+        z = x @ self.weights
+        p = 1.0 / (1.0 + np.exp(-z))
+        return df.with_column("probability", np.stack([1 - p, p], axis=1))
+
+
+class _TokenCountModel(Transformer):
+    """probability of class 1 rises with occurrences of the word 'good'."""
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        texts = [str(v) for v in df.col("text")]
+        score = np.asarray([t.split().count("good") for t in texts],
+                           np.float64)
+        p = 1.0 / (1.0 + np.exp(-(score - 0.5)))
+        return df.with_column("probability", np.stack([1 - p, p], axis=1))
+
+
+class TestRegressionSolvers:
+    def test_lasso_recovers_sparse_signal(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(200, 5))
+        y = 3.0 * x[:, 0] - 2.0 * x[:, 2] + 0.5
+        res = LassoRegression(alpha=0.01).fit(x, y)
+        assert res.coefficients[0] == pytest.approx(3.0, abs=0.1)
+        assert res.coefficients[2] == pytest.approx(-2.0, abs=0.1)
+        assert abs(res.coefficients[1]) < 0.05
+        assert res.intercept == pytest.approx(0.5, abs=0.1)
+        assert res.r_squared > 0.98
+
+    def test_lasso_strong_reg_zeroes_out(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(100, 3))
+        y = 0.1 * x[:, 0]
+        res = LassoRegression(alpha=10.0).fit(x, y)
+        assert np.allclose(res.coefficients, 0.0)
+
+    def test_least_squares_weighted(self):
+        x = np.asarray([[1.0], [2.0], [3.0], [10.0]])
+        y = np.asarray([2.0, 4.0, 6.0, 0.0])
+        w = np.asarray([1.0, 1.0, 1.0, 0.0])  # outlier zero-weighted
+        res = LeastSquaresRegression().fit(x, y, w)
+        assert res.coefficients[0] == pytest.approx(2.0, abs=1e-3)
+        assert res.r_squared == pytest.approx(1.0, abs=1e-4)
+
+
+def _tabular_df(n=30, seed=0):
+    rng = np.random.default_rng(seed)
+    return DataFrame({"x1": rng.normal(size=n), "x2": rng.normal(size=n)})
+
+
+class TestLIME:
+    def test_tabular_lime_finds_important_feature(self):
+        bg = _tabular_df(200, seed=1)
+        df = _tabular_df(5)
+        model = _LinearModel([2.0, 0.0], cols=["x1", "x2"])
+        lime = TabularLIME(model=model, inputCols=["x1", "x2"],
+                           backgroundData=bg, targetClasses=[1],
+                           numSamples=300)
+        out = lime.transform(df)
+        for row_exp in out.col("explanation"):
+            coefs = row_exp[0]  # class 1
+            assert abs(coefs[0]) > abs(coefs[1]) * 3
+        assert all(r[0] > 0.3 for r in out.col("r2"))
+
+    def test_vector_lime(self):
+        rng = np.random.default_rng(2)
+        bg = DataFrame({"features": rng.normal(size=(150, 3))})
+        df = DataFrame({"features": rng.normal(size=(4, 3))})
+        model = _LinearModel([0.0, 3.0, 0.0])
+        lime = VectorLIME(model=model, backgroundData=bg, targetClasses=[1],
+                          numSamples=200)
+        out = lime.transform(df)
+        for row_exp in out.col("explanation"):
+            coefs = row_exp[0]
+            assert np.argmax(np.abs(coefs)) == 1
+
+    def test_text_lime(self):
+        texts = np.asarray(["good movie really good",
+                            "bad film terrible plot"], dtype=object)
+        df = DataFrame({"text": texts})
+        lime = TextLIME(model=_TokenCountModel(), inputCol="text",
+                        targetClasses=[1], numSamples=200)
+        out = lime.transform(df)
+        toks = out.col("tokens")[0]
+        coefs = out.col("explanation")[0][0]
+        good_idx = [i for i, t in enumerate(toks) if t == "good"]
+        other_idx = [i for i, t in enumerate(toks) if t != "good"]
+        assert min(coefs[i] for i in good_idx) > \
+            max(abs(coefs[i]) for i in other_idx)
+
+
+class TestSHAP:
+    def test_tabular_shap_additivity(self):
+        bg = _tabular_df(100, seed=3)
+        df = _tabular_df(3, seed=4)
+        model = _LinearModel([1.5, -1.0], cols=["x1", "x2"])
+        shap = TabularSHAP(model=model, inputCols=["x1", "x2"],
+                           backgroundData=bg, targetClasses=[1])
+        out = shap.transform(df)
+        scored = model.transform(df)
+        for i, row_exp in enumerate(out.col("explanation")):
+            v = row_exp[0]  # [base, shap1, shap2]
+            assert len(v) == 3
+            fx = scored.col("probability")[i, 1]
+            # additivity: base + sum(shap) == f(x)
+            assert v.sum() == pytest.approx(fx, abs=0.05)
+        assert all(r[0] > 0.5 for r in out.col("r2"))
+
+    def test_vector_shap_importance_order(self):
+        rng = np.random.default_rng(5)
+        bg = DataFrame({"features": rng.normal(size=(100, 4))})
+        df = DataFrame({"features": rng.normal(size=(3, 4)) + 1.0})
+        model = _LinearModel([4.0, 0.0, 0.0, 0.0])
+        shap = VectorSHAP(model=model, backgroundData=bg, targetClasses=[1])
+        out = shap.transform(df)
+        for row_exp in out.col("explanation"):
+            shap_vals = row_exp[0][1:]
+            assert np.argmax(np.abs(shap_vals)) == 0
+
+    def test_text_shap(self):
+        df = DataFrame({"text": np.asarray(["good good movie plot"],
+                                           dtype=object)})
+        shap = TextSHAP(model=_TokenCountModel(), inputCol="text",
+                        targetClasses=[1], numSamples=40)
+        out = shap.transform(df)
+        toks = out.col("tokens")[0]
+        vals = out.col("explanation")[0][0][1:]
+        good = [vals[i] for i, t in enumerate(toks) if t == "good"]
+        rest = [vals[i] for i, t in enumerate(toks) if t != "good"]
+        assert min(good) > max(rest)
+
+
+class TestICE:
+    def test_pdp_average_monotone(self):
+        df = _tabular_df(50, seed=6)
+        model = _LinearModel([2.0, 0.0], cols=["x1", "x2"])
+        ice = ICETransformer(model=model, kind="average", targetClasses=[1],
+                             numericFeatures=[{"name": "x1", "numSplits": 4},
+                                              {"name": "x2", "numSplits": 4}])
+        out = ice.transform(df)
+        dep = out.col("x1_dependence")[0]
+        keys = sorted(dep.keys())
+        vals = [float(dep[k][0]) for k in keys]
+        assert vals == sorted(vals)  # monotone in x1
+        dep2 = out.col("x2_dependence")[0]
+        v2 = [float(v[0]) for v in dep2.values()]
+        assert max(v2) - min(v2) < 1e-6  # flat in x2
+
+    def test_ice_individual_shape(self):
+        df = _tabular_df(7, seed=7)
+        model = _LinearModel([1.0, 1.0], cols=["x1", "x2"])
+        ice = ICETransformer(model=model, kind="individual",
+                             targetClasses=[1],
+                             numericFeatures=[{"name": "x1", "numSplits": 3}])
+        out = ice.transform(df)
+        assert out.num_rows == 7
+        assert len(out.col("x1_dependence")[0]) == 4
+
+    def test_feature_importance_ranks(self):
+        df = _tabular_df(60, seed=8)
+        model = _LinearModel([3.0, 0.2], cols=["x1", "x2"])
+        ice = ICETransformer(model=model, kind="feature", targetClasses=[1],
+                             numericFeatures=[{"name": "x1"},
+                                              {"name": "x2"}])
+        out = ice.transform(df)
+        imp = {r["featureNames"]: float(np.asarray(r["pdpBasedDependence"])[0])
+               for r in out.iter_rows()}
+        assert imp["x1_dependence"] > imp["x2_dependence"] * 2

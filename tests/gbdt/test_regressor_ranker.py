@@ -148,7 +148,7 @@ def test_lambdarank_with_validation_split():
     # eval record must contain a finite valid ndcg for every iteration run
     assert model.evals_result
     for rec in model.evals_result:
-        assert np.isfinite(rec["valid0_ndcg"])
+        assert np.isfinite(rec["valid0_ndcg@3"])
     scores = model.transform(df)["prediction"]
     assert np.isfinite(scores).all()
 

@@ -1,0 +1,128 @@
+"""image module tests, patterned on the reference's ImageTransformerSuite /
+SuperpixelSuite (opencv + core image tests)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.image import (
+    ImageSetAugmenter,
+    ImageTransformer,
+    Superpixel,
+    SuperpixelTransformer,
+    UnrollImage,
+)
+
+
+def _images(n=3, h=32, w=24, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    col = np.empty(n, dtype=object)
+    for i in range(n):
+        col[i] = rng.uniform(0, 255, size=(h, w, c)).astype(np.float32)
+    return DataFrame({"image": col})
+
+
+class TestImageTransformer:
+    def test_resize(self):
+        df = _images()
+        out = ImageTransformer(inputCol="image", outputCol="out") \
+            .resize(16, 12).transform(df)
+        assert out.col("out")[0].shape == (16, 12, 3)
+
+    def test_crop_and_centercrop(self):
+        df = _images()
+        t = ImageTransformer(inputCol="image", outputCol="out") \
+            .crop(x=2, y=4, height=10, width=8)
+        got = t.transform(df).col("out")[0]
+        want = df.col("image")[0][4:14, 2:10, :]
+        assert np.allclose(got, want)
+        cc = ImageTransformer(inputCol="image", outputCol="out") \
+            .center_crop(10, 10).transform(df).col("out")[0]
+        assert cc.shape == (10, 10, 3)
+
+    def test_flip_gray_threshold(self):
+        df = _images()
+        src = df.col("image")[0]
+        flipped = ImageTransformer(inputCol="image", outputCol="o") \
+            .flip(1).transform(df).col("o")[0]
+        assert np.allclose(flipped, src[:, ::-1, :])
+        gray = ImageTransformer(inputCol="image", outputCol="o") \
+            .color_format("gray").transform(df).col("o")[0]
+        assert gray.shape == (32, 24, 1)
+        th = ImageTransformer(inputCol="image", outputCol="o") \
+            .threshold(128.0, 255.0).transform(df).col("o")[0]
+        assert set(np.unique(th)) <= {0.0, 255.0}
+
+    def test_blur_reduces_variance(self):
+        df = _images()
+        blurred = ImageTransformer(inputCol="image", outputCol="o") \
+            .blur(5, 5).transform(df).col("o")[0]
+        assert blurred.var() < df.col("image")[0].var()
+        g = ImageTransformer(inputCol="image", outputCol="o") \
+            .gaussian_kernel(5, 1.5).transform(df).col("o")[0]
+        assert g.var() < df.col("image")[0].var()
+
+    def test_normalize_and_tensor(self):
+        df = _images()
+        t = ImageTransformer(inputCol="image", outputCol="o", toTensor=True) \
+            .normalize(mean=[0.485, 0.456, 0.406], std=[0.229, 0.224, 0.225],
+                       color_scale_factor=1 / 255.0)
+        out = t.transform(df).col("o")[0]
+        assert out.shape == (3, 32, 24)  # CHW
+
+    def test_stage_chain_and_mixed_shapes(self):
+        col = np.empty(2, dtype=object)
+        rng = np.random.default_rng(0)
+        col[0] = rng.uniform(0, 255, (20, 20, 3)).astype(np.float32)
+        col[1] = rng.uniform(0, 255, (30, 40, 3)).astype(np.float32)
+        df = DataFrame({"image": col})
+        out = ImageTransformer(inputCol="image", outputCol="o") \
+            .resize(8, 8).color_format("gray").transform(df)
+        assert out.col("o")[0].shape == (8, 8, 1)
+        assert out.col("o")[1].shape == (8, 8, 1)
+
+    def test_unsupported_action_raises(self):
+        df = _images(1)
+        t = ImageTransformer(inputCol="image", outputCol="o")
+        t._paramMap["stages"] = [{"action": "sharpen"}]
+        with pytest.raises(ValueError, match="unsupported"):
+            t.transform(df)
+
+
+class TestAugmenterUnroll:
+    def test_augmenter_doubles(self):
+        df = _images(4)
+        out = ImageSetAugmenter(inputCol="image", outputCol="aug").transform(df)
+        assert out.num_rows == 8
+        assert np.allclose(out.col("aug")[4], df.col("image")[0][:, ::-1, :])
+
+    def test_unroll(self):
+        df = _images(2, h=4, w=5)
+        out = UnrollImage(inputCol="image", outputCol="vec").transform(df)
+        assert out.col("vec").shape == (2, 4 * 5 * 3)
+
+
+class TestSuperpixel:
+    def test_cluster_count_and_coverage(self):
+        img = np.zeros((32, 32, 3), np.float32)
+        img[:, 16:] = 255.0
+        labels = Superpixel.cluster(img, cell_size=8.0)
+        assert labels.shape == (32, 32)
+        k = labels.max() + 1
+        assert 4 <= k <= 32
+        clusters = Superpixel.get_clusters(labels)
+        assert sum(len(c) for c in clusters) == 32 * 32
+
+    def test_mask_image(self):
+        img = np.ones((8, 8, 3), np.float32)
+        labels = np.zeros((8, 8), np.int64)
+        labels[:, 4:] = 1
+        states = np.asarray([1.0, 0.0])
+        masked = Superpixel.mask_image(img, labels, states)
+        assert masked[:, :4].sum() == 8 * 4 * 3
+        assert masked[:, 4:].sum() == 0
+
+    def test_transformer(self):
+        df = _images(2, h=24, w=24)
+        out = SuperpixelTransformer(inputCol="image").transform(df)
+        assert out.col("superpixels")[0].shape == (24, 24)

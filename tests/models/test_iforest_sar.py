@@ -1,0 +1,132 @@
+"""isolationforest + recommendation tests, patterned on the reference's
+VerifyIsolationForest and SARSpec suites."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.isolationforest import IsolationForest
+from mmlspark_tpu.recommendation import (
+    RankingAdapter,
+    RankingEvaluator,
+    RankingTrainValidationSplit,
+    SAR,
+)
+
+
+class TestIsolationForest:
+    def test_outliers_score_higher(self):
+        rng = np.random.default_rng(0)
+        inliers = rng.normal(0, 1, size=(500, 2))
+        outliers = rng.normal(0, 1, size=(20, 2)) + 8.0
+        x = np.concatenate([inliers, outliers])
+        df = DataFrame({"features": x})
+        model = IsolationForest(numEstimators=50, maxSamples=128,
+                               contamination=0.04, randomSeed=3).fit(df)
+        out = model.transform(df)
+        scores = out.col("outlierScore")
+        assert scores[500:].mean() > scores[:500].mean() + 0.1
+        # most flagged points are true outliers
+        flagged = np.nonzero(out.col("predictedLabel"))[0]
+        assert len(flagged) > 0
+        assert (flagged >= 500).mean() > 0.7
+
+    def test_save_load(self, tmp_path):
+        rng = np.random.default_rng(1)
+        df = DataFrame({"features": rng.normal(size=(100, 3))})
+        model = IsolationForest(numEstimators=10).fit(df)
+        model.save(str(tmp_path / "if"))
+        from mmlspark_tpu.core.pipeline import PipelineStage
+        loaded = PipelineStage.load(str(tmp_path / "if"))
+        a = model.transform(df).col("outlierScore")
+        b = loaded.transform(df).col("outlierScore")
+        assert np.allclose(a, b)
+
+
+def _interactions(n_users=30, n_items=40, seed=0):
+    """Two user cliques with disjoint item tastes."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for u in range(n_users):
+        clique = u % 2
+        base = np.arange(n_items // 2) + clique * (n_items // 2)
+        liked = rng.choice(base, size=8, replace=False)
+        for it in liked:
+            rows.append((u, int(it), 1.0 + rng.random()))
+    users, items, ratings = map(np.asarray, zip(*rows))
+    return DataFrame({"user": users.astype(np.int64),
+                      "item": items.astype(np.int64),
+                      "rating": ratings.astype(np.float64)})
+
+
+class TestSAR:
+    def test_similarity_respects_cliques(self):
+        df = _interactions()
+        model = SAR(supportThreshold=1).fit(df)
+        sim = model._similarity
+        half = sim.shape[0] // 2
+        within = sim[:half, :half].sum() + sim[half:, half:].sum()
+        across = sim[:half, half:].sum() + sim[half:, :half].sum()
+        assert within > across * 5
+
+    def test_recommendations_stay_in_clique(self):
+        df = _interactions()
+        model = SAR(supportThreshold=1).fit(df)
+        recs = model.recommend_for_all_users(5)
+        assert recs.num_rows == 30
+        half = 20
+        for row in recs.iter_rows():
+            clique = row["user"] % 2
+            in_clique = [m for m in row["recommendations"]
+                         if (m["item"] >= half) == (clique == 1)]
+            assert len(in_clique) >= len(row["recommendations"]) * 0.6
+
+    def test_transform_scores_pairs(self):
+        df = _interactions()
+        model = SAR(supportThreshold=1).fit(df)
+        out = model.transform(df.head(10))
+        assert "prediction" in out
+        assert np.isfinite(out.col("prediction")).all()
+
+    def test_jaccard_vs_cooccurrence(self):
+        df = _interactions()
+        j = SAR(supportThreshold=1, similarityFunction="jaccard").fit(df)
+        c = SAR(supportThreshold=1,
+                similarityFunction="cooccurrence").fit(df)
+        assert (j._similarity <= 1.0 + 1e-9).all()
+        assert c._similarity.max() > 1.0  # raw counts
+
+
+class TestRanking:
+    def test_evaluator_known_values(self):
+        preds = np.empty(2, dtype=object)
+        labels = np.empty(2, dtype=object)
+        preds[0], labels[0] = [1, 2, 3], [1, 3]
+        preds[1], labels[1] = [9, 8], [7]
+        df = DataFrame({"prediction": preds, "label": labels})
+        ev = RankingEvaluator(k=3)
+        assert ev.match_metric("precisionAtk", df) == pytest.approx(
+            (2 / 3 + 0 / 3) / 2)
+        assert ev.match_metric("recallAtK", df) == pytest.approx(
+            (1.0 + 0.0) / 2)
+        assert ev.match_metric("mrr", df) == pytest.approx((1.0 + 0.0) / 2)
+        map0 = (1 / 1 + 2 / 3) / 2
+        assert ev.match_metric("map", df) == pytest.approx((map0 + 0.0) / 2)
+
+    def test_adapter_and_tvsplit(self):
+        df = _interactions(n_users=20)
+        adapter = RankingAdapter(recommender=SAR(supportThreshold=1), k=5)
+        model = adapter.fit(df)
+        out = model.transform(df)
+        assert set(out.columns) >= {"user", "prediction", "label"}
+        ndcg = RankingEvaluator(k=5).evaluate(out)
+        assert 0.0 <= ndcg <= 1.0
+
+        tv = RankingTrainValidationSplit(
+            estimator=SAR(supportThreshold=1),
+            estimatorParamMaps=[{"similarityFunction": "jaccard"},
+                                {"similarityFunction": "lift"}],
+            evaluator=RankingEvaluator(k=5), trainRatio=0.7, k=5)
+        tvm = tv.fit(df)
+        assert len(tvm.validation_metrics) == 2
+        assert tvm.get_best_model() is not None

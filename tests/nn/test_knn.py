@@ -1,0 +1,96 @@
+"""nn tests, patterned on the reference's BallTreeTest / KNNTest /
+ConditionalKNNTest (core/src/test/scala/.../nn/)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.nn import BallTree, ConditionalBallTree, ConditionalKNN, KNN
+
+
+def _grid(n=100, d=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(n, d))
+
+
+class TestBallTree:
+    def test_exact_vs_bruteforce(self):
+        keys = _grid(200)
+        tree = BallTree(keys, list(range(200)), leaf_size=10)
+        rng = np.random.default_rng(1)
+        for _ in range(20):
+            q = rng.normal(size=3)
+            got = tree.find_maximum_inner_products(q, k=5)
+            ips = keys @ q
+            want = np.argsort(-ips)[:5]
+            assert [m.index for m in got] == list(want)
+            assert got[0].distance == pytest.approx(float(ips[want[0]]))
+
+    def test_all_points_single_leaf(self):
+        keys = _grid(20)
+        tree = BallTree(keys, list(range(20)), leaf_size=50)
+        q = np.ones(3)
+        got = tree.find_maximum_inner_products(q, k=3)
+        assert len(got) == 3
+
+    def test_conditional(self):
+        keys = _grid(100)
+        labels = ["even" if i % 2 == 0 else "odd" for i in range(100)]
+        tree = ConditionalBallTree(keys, list(range(100)), labels, leaf_size=8)
+        q = np.ones(3)
+        got = tree.find_maximum_inner_products(q, {"odd"}, k=4)
+        assert all(m.index % 2 == 1 for m in got)
+        ips = keys @ q
+        odd_best = max((ips[i], i) for i in range(100) if i % 2 == 1)
+        assert got[0].index == odd_best[1]
+
+
+class TestKNN:
+    def test_transform_matches_bruteforce(self):
+        keys = _grid(150)
+        df = DataFrame({"features": keys,
+                        "values": np.asarray([f"v{i}" for i in range(150)],
+                                             dtype=object)})
+        model = KNN(k=4, outputCol="matches").fit(df)
+        queries = _grid(10, seed=9)
+        out = model.transform(DataFrame({"features": queries}))
+        for r in range(10):
+            ips = keys @ queries[r]
+            want = np.argsort(-ips)[:4]
+            got = out.col("matches")[r]
+            assert [m["value"] for m in got] == [f"v{i}" for i in want]
+            assert got[0]["distance"] == pytest.approx(float(ips[want[0]]),
+                                                       rel=1e-4)
+
+    def test_save_load(self, tmp_path):
+        keys = _grid(50)
+        df = DataFrame({"features": keys, "values": np.arange(50)})
+        model = KNN(k=2).fit(df)
+        model.save(str(tmp_path / "knn"))
+        from mmlspark_tpu.core.pipeline import PipelineStage
+        loaded = PipelineStage.load(str(tmp_path / "knn"))
+        q = DataFrame({"features": _grid(5, seed=3)})
+        a = model.transform(q).col("output")
+        b = loaded.transform(q).col("output")
+        assert [[m["value"] for m in row] for row in a] == \
+               [[m["value"] for m in row] for row in b]
+
+
+class TestConditionalKNN:
+    def test_conditioner_restricts(self):
+        keys = _grid(120)
+        labels = np.asarray(["a", "b", "c"] * 40, dtype=object)
+        df = DataFrame({"features": keys, "values": np.arange(120),
+                        "label": labels})
+        model = ConditionalKNN(k=3, outputCol="m").fit(df)
+        queries = _grid(6, seed=4)
+        conds = np.empty(6, dtype=object)
+        for i in range(6):
+            conds[i] = ["a"] if i % 2 == 0 else ["b", "c"]
+        out = model.transform(DataFrame({"features": queries,
+                                         "conditioner": conds}))
+        for r in range(6):
+            allowed = set(conds[r])
+            for m in out.col("m")[r]:
+                assert m["label"] in allowed
+            assert len(out.col("m")[r]) == 3

@@ -1,0 +1,153 @@
+"""train/ + automl/ tests, patterned on the reference's
+VerifyTrainClassifier / VerifyComputeModelStatistics /
+VerifyTuneHyperparameters suites."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.train import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+    TrainClassifier,
+    TrainRegressor,
+)
+from mmlspark_tpu.automl import (
+    DiscreteHyperParam,
+    FindBestModel,
+    HyperparamBuilder,
+    RangeHyperParam,
+    TuneHyperparameters,
+)
+
+
+def _classification_df(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    cat = rng.choice(["a", "b", "c"], size=n)
+    logit = 1.5 * x1 - x2 + (cat == "a") * 1.0
+    y = (logit + rng.normal(size=n) * 0.3 > 0).astype(np.float64)
+    return DataFrame({"x1": x1, "x2": x2, "cat": cat, "label": y})
+
+
+def _regression_df(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = 2.0 * x1 - 0.5 * x2 + rng.normal(size=n) * 0.1
+    return DataFrame({"x1": x1, "x2": x2, "label": y})
+
+
+class TestTrainClassifier:
+    def test_fit_transform_accuracy(self):
+        df = _classification_df()
+        model = TrainClassifier(labelCol="label").fit(df)
+        scored = model.transform(df)
+        assert "prediction" in scored
+        acc = np.mean(scored.col("prediction") == df.col("label"))
+        assert acc > 0.85
+
+    def test_string_labels_roundtrip(self):
+        df = _classification_df()
+        names = np.where(df.col("label") > 0, "yes", "no")
+        df = df.with_column("label", names)
+        model = TrainClassifier(labelCol="label").fit(df)
+        scored = model.transform(df)
+        assert set(np.unique(scored.col("scored_labels"))) <= {"yes", "no"}
+        acc = np.mean(scored.col("scored_labels") == names)
+        assert acc > 0.85
+
+
+class TestTrainRegressor:
+    def test_fit_transform_r2(self):
+        df = _regression_df()
+        model = TrainRegressor(labelCol="label").fit(df)
+        scored = model.transform(df)
+        stats = ComputeModelStatistics(
+            labelCol="label", evaluationMetric="regression").transform(scored)
+        assert float(stats.col("r2")[0]) > 0.8
+
+
+class TestComputeModelStatistics:
+    def test_binary_metrics(self):
+        labels = np.array([0, 0, 1, 1, 1, 0], dtype=np.float64)
+        preds = np.array([0, 1, 1, 1, 0, 0], dtype=np.float64)
+        probs = np.array([0.1, 0.6, 0.9, 0.8, 0.4, 0.2])
+        df = DataFrame({"label": labels, "prediction": preds, "probability": probs})
+        out = ComputeModelStatistics(
+            labelCol="label", scoresCol="probability").transform(df)
+        assert float(out.col("accuracy")[0]) == pytest.approx(4 / 6)
+        assert float(out.col("precision")[0]) == pytest.approx(2 / 3)
+        assert float(out.col("recall")[0]) == pytest.approx(2 / 3)
+        # positives {0.9,0.8,0.4} vs negatives {0.1,0.6,0.2}: 8/9 concordant
+        assert float(out.col("AUC")[0]) == pytest.approx(8 / 9, abs=1e-6)
+        cm = np.asarray(out.col("confusion_matrix")[0])
+        assert cm.tolist() == [[2, 1], [1, 2]]
+
+    def test_regression_metrics(self):
+        y = np.array([1.0, 2.0, 3.0])
+        p = np.array([1.1, 1.9, 3.2])
+        df = DataFrame({"label": y, "prediction": p})
+        out = ComputeModelStatistics(
+            labelCol="label", evaluationMetric="regression").transform(df)
+        assert float(out.col("mse")[0]) == pytest.approx(np.mean((p - y) ** 2))
+        assert float(out.col("rmse")[0]) == pytest.approx(
+            np.sqrt(np.mean((p - y) ** 2)))
+        assert float(out.col("mae")[0]) == pytest.approx(np.mean(np.abs(p - y)))
+        assert 0.9 < float(out.col("r2")[0]) < 1.0
+
+    def test_multiclass_metrics(self):
+        labels = np.array([0, 1, 2, 2, 1, 0], dtype=np.int64)
+        preds = np.array([0, 1, 2, 1, 1, 0], dtype=np.int64)
+        df = DataFrame({"label": labels, "prediction": preds})
+        out = ComputeModelStatistics(labelCol="label").transform(df)
+        assert float(out.col("accuracy")[0]) == pytest.approx(5 / 6)
+        assert "macro_averaged_precision" in out
+
+    def test_per_instance(self):
+        df = DataFrame({"label": np.array([1.0, 2.0]),
+                        "prediction": np.array([1.5, 2.0])})
+        out = ComputePerInstanceStatistics(labelCol="label").transform(df)
+        assert np.allclose(out.col("L1_loss"), [0.5, 0.0])
+        assert np.allclose(out.col("L2_loss"), [0.25, 0.0])
+
+
+class TestAutoML:
+    def test_tune_hyperparameters(self):
+        from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+        df = _classification_df(300).drop("cat")
+        df = df.with_column(
+            "features", np.stack([df.col("x1"), df.col("x2")], axis=1)
+        ).drop("x1", "x2")
+        space = (HyperparamBuilder()
+                 .add_hyperparam("numLeaves", DiscreteHyperParam([4, 15]))
+                 .add_hyperparam("numIterations", RangeHyperParam(5, 10))
+                 .build())
+        tuner = TuneHyperparameters(
+            models=[LightGBMClassifier(featuresCol="features")],
+            paramSpace=space, evaluationMetric="accuracy",
+            numFolds=2, numRuns=3, parallelism=2, seed=7)
+        model = tuner.fit(df)
+        assert model.get_best_metric() > 0.8
+        scored = model.transform(df)
+        assert "prediction" in scored
+        assert len(model.all_metrics) == 3
+
+    def test_find_best_model(self):
+        from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+        df = _classification_df(300).drop("cat")
+        df = df.with_column(
+            "features", np.stack([df.col("x1"), df.col("x2")], axis=1)
+        ).drop("x1", "x2")
+        weak = LightGBMClassifier(featuresCol="features",
+                                  numIterations=1, numLeaves=2).fit(df)
+        strong = LightGBMClassifier(featuresCol="features",
+                                    numIterations=20).fit(df)
+        fbm = FindBestModel(models=[weak, strong],
+                            evaluationMetric="accuracy").fit(df)
+        assert fbm.get_best_model() is strong
+        metrics_df = fbm.get_all_model_metrics()
+        assert metrics_df.num_rows == 2

@@ -1,0 +1,62 @@
+"""CSE + dsjson transformer tests (VerifyVowpalWabbitCSETransformer
+parity)."""
+
+import json
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.models.vw import (
+    VowpalWabbitCSETransformer,
+    VowpalWabbitDSJsonTransformer,
+)
+
+
+def _dsjson_rows(n=40, seed=0):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        cost = -1.0 if rng.random() < 0.4 else 0.0
+        lines.append(json.dumps({
+            "EventId": f"e{i}",
+            "_label_probability": 0.5,
+            "_label_cost": cost,
+            "_labelIndex": 0,
+            "p": [0.8, 0.2],
+            "a": [1, 2],
+        }))
+    return DataFrame({"value": np.asarray(lines, dtype=object)})
+
+
+def test_dsjson_decode():
+    df = _dsjson_rows(5)
+    out = VowpalWabbitDSJsonTransformer(dsJsonColumn="value").transform(df)
+    assert out.col("EventId")[0] == "e0"
+    assert out.col("probabilityLogged")[0] == 0.5
+    assert out.col("probabilities")[0] == [0.8, 0.2]
+    assert "reward" in out.col("rewards")[0]
+
+
+def test_cse_metrics_global_and_stratified():
+    df = _dsjson_rows(60)
+    decoded = VowpalWabbitDSJsonTransformer(dsJsonColumn="value").transform(df)
+    # predicted probability of the logged action under the new policy
+    rng = np.random.default_rng(1)
+    decoded = decoded.with_column("probabilityPredicted",
+                                  rng.uniform(0.3, 0.9, decoded.num_rows))
+    out = VowpalWabbitCSETransformer().transform(decoded)
+    assert out.num_rows == 1
+    row = next(out.iter_rows())
+    assert row["exampleCount"] == 60
+    assert 0 < row["averageImportanceWeight"] < 2.0
+    assert "reward_snips" in out.columns
+    assert row["reward_cressieReadIntervalLow"] <= \
+        row["reward_cressieReadIntervalHigh"]
+
+    # stratified by a synthetic segment column
+    seg = np.where(np.arange(60) % 2 == 0, "a", "b")
+    seg_df = decoded.with_column("segment", seg.astype(object))
+    out2 = VowpalWabbitCSETransformer(
+        metricsStratificationCols=["segment"]).transform(seg_df)
+    assert out2.num_rows == 2
+    assert set(out2.col("stratum")) == {"a", "b"}
