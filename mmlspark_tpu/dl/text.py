@@ -1,0 +1,83 @@
+"""DeepTextClassifier + hashing tokenizer.
+
+Parity: dl/DeepTextClassifier.py:1 — text column + label column,
+checkpoint-style backbone, batch/epoch/LR params, DP training. The HF
+checkpoint download is replaced by the in-repo TextTransformer trained
+from scratch (zero-egress); tokenization is the same hashing-trick
+scheme VW featurization uses, so no vocabulary files are needed.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import Param, gt, to_int, to_str
+from mmlspark_tpu.dl.backbones import TextTransformer
+from mmlspark_tpu.dl.estimator import DeepEstimator, DeepModel
+from mmlspark_tpu.ops.hashing import murmur3_32
+
+
+def hash_tokenize(texts: List[str], max_len: int, vocab_size: int
+                  ) -> np.ndarray:
+    """Whitespace tokens -> hashed ids in [1, vocab); 0 is padding."""
+    out = np.zeros((len(texts), max_len), np.int32)
+    for i, t in enumerate(texts):
+        toks = str(t).lower().split()[:max_len]
+        for j, tok in enumerate(toks):
+            out[i, j] = (murmur3_32(tok) % (vocab_size - 1)) + 1
+    return out
+
+
+class _TextParams:
+    maxLength = Param("maxLength", "max tokens per document", to_int, gt(0),
+                      default=64)
+    vocabSize = Param("vocabSize", "hashed vocabulary size", to_int, gt(1),
+                      default=1 << 15)
+    embeddingDim = Param("embeddingDim", "transformer width", to_int, gt(0),
+                         default=64)
+    numLayers = Param("numLayers", "transformer depth", to_int, gt(0),
+                      default=2)
+    numHeads = Param("numHeads", "attention heads", to_int, gt(0), default=4)
+    textCol = Param("textCol", "text column", to_str, default="text")
+
+
+class DeepTextClassifier(DeepEstimator, _TextParams):
+    def _build_module(self, num_classes: int):
+        return TextTransformer(
+            num_classes=num_classes, vocab_size=self.get("vocabSize"),
+            dim=self.get("embeddingDim"), heads=self.get("numHeads"),
+            layers=self.get("numLayers"), max_len=self.get("maxLength"))
+
+    def _featurize(self, dataset: DataFrame) -> Tuple[np.ndarray, np.ndarray]:
+        ids = hash_tokenize([str(v) for v in
+                             dataset.col(self.get("textCol"))],
+                            self.get("maxLength"), self.get("vocabSize"))
+        y = np.asarray(dataset.col(self.get("labelCol"))).astype(np.int64)
+        return ids, y
+
+    def _make_model(self, module, params, classes) -> "DeepTextModel":
+        model = DeepTextModel(
+            **{p.name: v for p, v in self.iter_set_params()
+               if DeepTextModel.has_param(p.name)})
+        model._init_state(module, params, classes)
+        return model
+
+
+class DeepTextModel(DeepModel, _TextParams):
+    def _featurize_x(self, dataset: DataFrame) -> np.ndarray:
+        return hash_tokenize([str(v) for v in
+                              dataset.col(self.get("textCol"))],
+                             self.get("maxLength"), self.get("vocabSize"))
+
+    def _rebuild_module(self):
+        return TextTransformer(
+            num_classes=len(self._classes),
+            vocab_size=self.get("vocabSize"), dim=self.get("embeddingDim"),
+            heads=self.get("numHeads"), layers=self.get("numLayers"),
+            max_len=self.get("maxLength"))
+
+    def _dummy_input(self) -> np.ndarray:
+        return np.zeros((1, self.get("maxLength")), np.int32)

@@ -30,6 +30,7 @@ import numpy as np
 DATA_AXIS = "dp"
 FEATURE_AXIS = "fp"
 MODEL_AXIS = "mp"
+SEQUENCE_AXIS = "sp"
 
 
 def data_axis() -> str:
@@ -44,26 +45,38 @@ def model_axis() -> str:
     return MODEL_AXIS
 
 
+def sequence_axis() -> str:
+    return SEQUENCE_AXIS
+
+
 @dataclass
 class MeshConfig:
-    """Declarative mesh shape; -1 means "all remaining devices"."""
+    """Declarative mesh shape; -1 means "all remaining devices".
+
+    ``sp`` is the sequence/context-parallel axis used by the
+    long-context attention ops (:mod:`mmlspark_tpu.parallel.attention`);
+    like the others it defaults to 1 so existing data-parallel programs
+    are unchanged.
+    """
 
     dp: int = -1
     fp: int = 1
     mp: int = 1
+    sp: int = 1
 
-    def resolve(self, num_devices: int) -> Tuple[int, int, int]:
-        dp, fp, mp = self.dp, self.fp, self.mp
-        fixed = max(fp, 1) * max(mp, 1)
+    def resolve(self, num_devices: int) -> Tuple[int, int, int, int]:
+        dp, fp, mp, sp = self.dp, self.fp, self.mp, self.sp
+        fixed = max(fp, 1) * max(mp, 1) * max(sp, 1)
         if dp == -1:
             if num_devices % fixed:
                 raise ValueError(
-                    f"{num_devices} devices not divisible by fp*mp={fixed}")
+                    f"{num_devices} devices not divisible by "
+                    f"fp*mp*sp={fixed}")
             dp = num_devices // fixed
-        if dp * fp * mp != num_devices:
+        if dp * fp * mp * sp != num_devices:
             raise ValueError(
-                f"mesh {dp}x{fp}x{mp} != {num_devices} devices")
-        return dp, fp, mp
+                f"mesh {dp}x{fp}x{mp}x{sp} != {num_devices} devices")
+        return dp, fp, mp, sp
 
 
 def create_mesh(config: Optional[MeshConfig] = None,
@@ -78,9 +91,17 @@ def create_mesh(config: Optional[MeshConfig] = None,
 
     devices = list(devices if devices is not None else jax.devices())
     config = config or MeshConfig()
-    dp, fp, mp = config.resolve(len(devices))
-    names = tuple(axis_names) if axis_names else (DATA_AXIS, FEATURE_AXIS, MODEL_AXIS)
-    dev_array = np.array(devices).reshape(dp, fp, mp)
+    dp, fp, mp, sp = config.resolve(len(devices))
+    names = tuple(axis_names) if axis_names else (
+        DATA_AXIS, FEATURE_AXIS, MODEL_AXIS, SEQUENCE_AXIS)
+    shape = (dp, fp, mp, sp)
+    if len(names) == 3:
+        if sp != 1:
+            raise ValueError("3 axis names require sp == 1")
+        shape = (dp, fp, mp)
+    elif len(names) != 4:
+        raise ValueError(f"need 3 or 4 axis names, got {names}")
+    dev_array = np.array(devices).reshape(shape)
     return jax.sharding.Mesh(dev_array, names)
 
 

@@ -1,0 +1,109 @@
+"""dl tests, patterned on the reference's test_deep_vision_classifier /
+test_deep_text_classifier python suites (deep-learning/src/test/python)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.dl import (
+    DeepTextClassifier,
+    DeepVisionClassifier,
+    SentenceEmbedder,
+)
+
+
+def _image_df(n=64, seed=0):
+    """Two classes: bright-top vs bright-bottom images."""
+    rng = np.random.default_rng(seed)
+    imgs = np.empty(n, dtype=object)
+    labels = np.zeros(n)
+    for i in range(n):
+        img = rng.uniform(0, 0.2, (12, 12, 3)).astype(np.float32)
+        cls = i % 2
+        if cls == 0:
+            img[:6] += 0.7
+        else:
+            img[6:] += 0.7
+        imgs[i] = img
+        labels[i] = cls
+    return DataFrame({"image": imgs, "label": labels})
+
+
+def _text_df(n=80, seed=0):
+    rng = np.random.default_rng(seed)
+    pos = ["great wonderful fantastic", "excellent amazing great",
+           "wonderful superb fantastic"]
+    neg = ["terrible awful horrible", "bad dreadful terrible",
+           "horrible awful poor"]
+    texts, labels = [], []
+    for i in range(n):
+        cls = i % 2
+        texts.append((pos if cls else neg)[rng.integers(3)])
+        labels.append(cls)
+    return DataFrame({"text": np.asarray(texts, dtype=object),
+                      "label": np.asarray(labels, np.float64)})
+
+
+class TestDeepVision:
+    def test_learns_separable_images(self):
+        df = _image_df()
+        est = DeepVisionClassifier(backbone="simple_cnn", batchSize=16,
+                                   maxEpochs=8, learningRate=3e-3,
+                                   labelCol="label", imageCol="image")
+        model = est.fit(df)
+        out = model.transform(df)
+        acc = (out.col("prediction") == df.col("label")).mean()
+        assert acc > 0.9
+        assert out.col("probability").shape == (64, 2)
+        assert model.train_seconds > 0
+
+    def test_save_load_roundtrip(self, tmp_path):
+        df = _image_df(32)
+        model = DeepVisionClassifier(backbone="simple_cnn", batchSize=16,
+                                     maxEpochs=2, labelCol="label").fit(df)
+        model.save(str(tmp_path / "dv"))
+        from mmlspark_tpu.core.pipeline import PipelineStage
+        loaded = PipelineStage.load(str(tmp_path / "dv"))
+        a = model.transform(df).col("probability")
+        b = loaded.transform(df).col("probability")
+        assert np.allclose(a, b, atol=1e-5)
+
+    def test_unknown_backbone_raises(self):
+        with pytest.raises(ValueError, match="unknown backbone"):
+            DeepVisionClassifier(backbone="resnet999",
+                                 labelCol="label").fit(_image_df(8))
+
+
+class TestDeepText:
+    def test_learns_sentiment_words(self):
+        df = _text_df()
+        est = DeepTextClassifier(batchSize=16, maxEpochs=10,
+                                 learningRate=3e-3, labelCol="label",
+                                 maxLength=8, embeddingDim=32, numLayers=1,
+                                 numHeads=2)
+        model = est.fit(df)
+        out = model.transform(df)
+        acc = (out.col("prediction") == df.col("label")).mean()
+        assert acc > 0.9
+
+    def test_embedder_from_model_and_fresh(self):
+        df = _text_df(20)
+        model = DeepTextClassifier(batchSize=10, maxEpochs=2,
+                                   labelCol="label", maxLength=8,
+                                   embeddingDim=32, numLayers=1,
+                                   numHeads=2).fit(df)
+        emb = SentenceEmbedder.from_text_model(model)
+        out = emb.transform(df)
+        assert out.col("embeddings").shape == (20, 32)
+        # same text -> same embedding; different texts differ
+        e = out.col("embeddings")
+        texts = df.col("text")
+        same = [i for i in range(1, 20) if texts[i] == texts[0]]
+        if same:
+            assert np.allclose(e[0], e[same[0]], atol=1e-5)
+
+        fresh = SentenceEmbedder(inputCol="text", outputCol="embeddings",
+                                 maxLength=8, embeddingDim=16, numLayers=1,
+                                 numHeads=2)
+        out2 = fresh.transform(df)
+        assert out2.col("embeddings").shape == (20, 16)
