@@ -97,6 +97,10 @@ def _store_complex(name: str, value: Any, path: str, arrays: Dict[str, np.ndarra
         with open(os.path.join(path, f"param_{name}", "count.json"), "w") as f:
             json.dump(len(value), f)
         return "stage_list"
+    if isinstance(value, (bytes, bytearray)):
+        with open(os.path.join(path, f"param_{name}.bin"), "wb") as f:
+            f.write(value)
+        return "bytes"
     # last resort: JSON-able structure
     with open(os.path.join(path, f"param_{name}.json"), "w") as f:
         json.dump(value, f, default=_json_default)
@@ -113,6 +117,9 @@ def _load_complex(name: str, kind: str, path: str, arrays: Dict[str, np.ndarray]
         with open(os.path.join(base, "count.json")) as f:
             n = json.load(f)
         return [load_stage(os.path.join(base, str(i))) for i in range(n)]
+    if kind == "bytes":
+        with open(os.path.join(path, f"param_{name}.bin"), "rb") as f:
+            return f.read()
     with open(os.path.join(path, f"param_{name}.json")) as f:
         return json.load(f)
 

@@ -37,6 +37,7 @@ class SentenceEmbedder(Transformer, HasInputCol, HasOutputCol):
 
     _module = None
     _params = None
+    _apply_jit = None
 
     @staticmethod
     def from_text_model(model, inputCol: str = "text",
@@ -78,7 +79,10 @@ class SentenceEmbedder(Transformer, HasInputCol, HasOutputCol):
         ids = hash_tokenize([str(v) for v in
                              dataset.col(self.get("inputCol"))],
                             self.get("maxLength"), self.get("vocabSize"))
-        apply = jax.jit(lambda p, xb: self._module.apply(p, xb))
+        if self._apply_jit is None:  # cache: avoid per-call retraces
+            self._apply_jit = jax.jit(
+                lambda p, xb: self._module.apply(p, xb))
+        apply = self._apply_jit
         bs = self.get("batchSize")
         outs = []
         for s in range(0, len(ids), bs):

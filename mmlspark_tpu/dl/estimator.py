@@ -55,17 +55,17 @@ class DeepEstimator(Estimator, _DeepParams):
     def _make_model(self, module, params, classes) -> "DeepModel":
         raise NotImplementedError
 
-    def _num_classes(self, y: np.ndarray) -> int:
-        return int(y.max()) + 1
-
     def _fit(self, dataset: DataFrame) -> "DeepModel":
         import jax
         import jax.numpy as jnp
         import optax
 
-        x, y = self._featurize(dataset)
-        classes = np.unique(y)
-        num_classes = self._num_classes(y)
+        x, y_raw = self._featurize(dataset)
+        classes = np.unique(y_raw)
+        # train on dense class indices so non-contiguous labels (e.g.
+        # {1, 2}) map correctly at prediction time
+        y = np.searchsorted(classes, y_raw)
+        num_classes = len(classes)
         module = self._build_module(num_classes)
 
         mesh = self.get("mesh") or default_mesh()
@@ -179,11 +179,18 @@ class DeepModel(Model, _DeepParams):
     def _dummy_input(self) -> np.ndarray:
         raise NotImplementedError
 
+    _apply_jit = None
+
     def _logits(self, x: np.ndarray, batch: int = 256) -> np.ndarray:
         import jax
         import jax.numpy as jnp
 
-        apply = jax.jit(lambda p, xb: self._module.apply(p, xb))
+        if self._apply_jit is None:
+            # cache per instance: a fresh jit wrapper per call would
+            # retrace + recompile on every transform
+            self._apply_jit = jax.jit(
+                lambda p, xb: self._module.apply(p, xb))
+        apply = self._apply_jit
         outs = []
         for s in range(0, len(x), batch):
             xb = x[s:s + batch]
@@ -201,8 +208,7 @@ class DeepModel(Model, _DeepParams):
         x = self._featurize_x(dataset)
         logits = self._logits(x)
         probs = np.asarray(jax.nn.softmax(logits, axis=-1))
-        pred_idx = probs.argmax(axis=1)
-        pred = self._classes[np.clip(pred_idx, 0, len(self._classes) - 1)]
+        pred = self._classes[probs.argmax(axis=1)]
         return dataset.with_columns({
             "probability": probs,
             self.get("predictionCol"): pred.astype(np.float64)

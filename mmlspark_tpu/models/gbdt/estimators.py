@@ -100,6 +100,9 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCo
                         one_of("data_parallel", "voting_parallel",
                                "feature_parallel", "serial"),
                         default="data_parallel")
+    topK = Param("topK", "voting_parallel local vote size "
+                 "(LightGBMConstants.scala:22-24)", to_int, gt(0),
+                 default=20)
     useBarrierExecutionMode = Param("useBarrierExecutionMode",
                                     "gang scheduling (TPU meshes are natively "
                                     "gang-scheduled; accepted for parity)",
@@ -143,6 +146,11 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCo
             sigmoid=sigmoid,
             early_stopping_round=self.get("earlyStoppingRound"),
             metric=self.get("metric"),
+            tree_learner={"data_parallel": "data",
+                          "voting_parallel": "voting",
+                          "feature_parallel": "feature",
+                          "serial": "serial"}[self.get("parallelism")],
+            top_k=self.get("topK"),
             seed=self.get("seed"),
             **extra,
         )

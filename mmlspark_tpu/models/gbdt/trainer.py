@@ -76,6 +76,12 @@ class TrainConfig:
     early_stopping_round: int = 0
     metric: Optional[str] = None
     eval_at: Any = 5              # NDCG@k position(s): int or list of ints
+    # distributed tree learner (LightGBMParams.scala:25-29):
+    # serial | data | voting | feature — "data" is the default sharded
+    # path (XLA-derived histogram all-reduce); voting/feature use the
+    # explicit shard_map builders in parallel_modes.py
+    tree_learner: str = "serial"
+    top_k: int = 20               # voting_parallel local vote size
     seed: int = 0
     deterministic: bool = True
     boost_from_average: bool = True
@@ -319,14 +325,37 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
             base_score = (obj_mod.init_score(cfg.objective, labels, weights)
                           if cfg.boost_from_average and cfg.objective != "lambdarank"
                           else 0.0)
-        dev_put = (lambda a, nd=1: jax.device_put(
-            a, row_sharded(mesh, nd)) if mesh is not None else jnp.asarray(a))
-        binned_d = dev_put(np.ascontiguousarray(binned, dtype=np.int32), 2)
+        feature_mode = cfg.tree_learner == "feature" and mesh is not None
+        if feature_mode:
+            # feature_parallel: rows replicated, features sharded on fp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from mmlspark_tpu.parallel.mesh import FEATURE_AXIS
+            dev_put = lambda a, nd=1: jax.device_put(a, replicated(mesh))  # noqa: E731
+            binned_d = jax.device_put(
+                np.ascontiguousarray(binned, dtype=np.int32),
+                NamedSharding(mesh, P(None, FEATURE_AXIS)))
+        else:
+            dev_put = (lambda a, nd=1: jax.device_put(
+                a, row_sharded(mesh, nd)) if mesh is not None
+                else jnp.asarray(a))
+            binned_d = dev_put(np.ascontiguousarray(binned, dtype=np.int32),
+                               2)
         labels_d = dev_put(np.asarray(labels, dtype=np.float32))
         weights_d = None if weights is None else dev_put(
             np.asarray(weights, dtype=np.float32))
 
-    build_tree = make_build_tree(num_f, total_bins, cfg)
+    if cfg.tree_learner == "voting" and mesh is not None:
+        from mmlspark_tpu.models.gbdt.parallel_modes import (
+            make_build_tree_voting)
+        build_tree = make_build_tree_voting(num_f, total_bins, cfg, mesh)
+    elif feature_mode:
+        from mmlspark_tpu.models.gbdt.parallel_modes import (
+            make_build_tree_feature_parallel)
+        build_tree = make_build_tree_feature_parallel(num_f, total_bins, cfg,
+                                                      mesh)
+    else:
+        build_tree = make_build_tree(num_f, total_bins, cfg)
     build_tree = jax.jit(build_tree)
 
     def predict_tree_binned(sf, tb, nv, bd):

@@ -1,0 +1,15 @@
+"""ONNX-graph inference compiled through XLA.
+
+Parity surface: reference deep-learning module's ONNX stack
+(onnx/ONNXModel.scala:211, ONNXRuntime.scala:25-108, ONNXUtils.scala:1,
+ONNXHub.scala:72-99, ImageFeaturizer.scala:34). The onnxruntime-CUDA
+session is replaced by importing the ONNX graph into jax and letting
+XLA compile it for TPU (SURVEY.md §2.7 ONNX row); per-task GPU
+selection becomes per-core batch sharding.
+"""
+
+from mmlspark_tpu.onnx.convert import OnnxGraph, convert_model, load_model
+from mmlspark_tpu.onnx.model import ImageFeaturizer, ONNXHub, ONNXModel
+
+__all__ = ["ONNXModel", "ImageFeaturizer", "ONNXHub",
+           "load_model", "convert_model", "OnnxGraph"]

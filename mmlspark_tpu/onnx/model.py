@@ -1,0 +1,197 @@
+"""ONNXModel transformer + ImageFeaturizer + hub stub.
+
+Parity: onnx/ONNXModel.scala:211-256 — feedDict (model input name ->
+DataFrame column), fetchDict (output column -> graph tensor name, which
+may be an INTERMEDIATE tensor: the graph is sliced there exactly like
+sliceAtOutputs, :207), miniBatchSize batching, softMaxDict/argMaxDict
+post-ops (:255-301). ImageFeaturizer (onnx/ImageFeaturizer.scala:34)
+chains ImageTransformer preprocessing into a headless network.
+
+TPU-first: one jitted graph evaluation per batch; the reference's
+per-task GPU selection (ONNXRuntime.scala:47-57) is unnecessary — XLA
+owns the chip, and batch rows shard over cores via the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (
+    HasInputCol, HasOutputCol, Param, gt, to_int, to_str,
+)
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.onnx.convert import OnnxGraph, load_model
+
+
+class ONNXModel(Transformer):
+    modelPayload = Param("modelPayload", "ONNX model bytes", is_complex=True)
+    feedDict = Param("feedDict", "model input name -> input column",
+                     is_complex=True)
+    fetchDict = Param("fetchDict", "output column -> graph tensor name",
+                      is_complex=True)
+    miniBatchSize = Param("miniBatchSize", "rows per device batch", to_int,
+                          gt(0), default=256)
+    softMaxDict = Param("softMaxDict", "input col -> output col softmax "
+                        "post-op", is_complex=True)
+    argMaxDict = Param("argMaxDict", "input col -> output col argmax "
+                       "post-op", is_complex=True)
+
+    _graph: Optional[OnnxGraph] = None
+    _run = None
+
+    def set_model_location(self, path: str) -> "ONNXModel":
+        with open(path, "rb") as f:
+            self._set(modelPayload=f.read())
+        return self
+
+    def _ensure_graph(self):
+        if self._graph is None:
+            fetch = self.get("fetchDict") or {}
+            outputs = list(fetch.values()) or None
+            self._graph = OnnxGraph(load_model(self.get("modelPayload")),
+                                    outputs)
+            import jax
+            self._run = jax.jit(self._graph.convert())
+        return self._graph
+
+    @property
+    def model_inputs(self) -> Dict[str, tuple]:
+        return dict(self._ensure_graph().input_shapes)
+
+    @property
+    def model_outputs(self) -> List[str]:
+        return list(self._ensure_graph().all_output_names)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        graph = self._ensure_graph()
+        feed = self.get("feedDict") or {
+            graph.input_names[0]: "features"}
+        fetch = self.get("fetchDict") or {
+            "output": graph.output_names[0]}
+        bs = self.get("miniBatchSize")
+        n = dataset.num_rows
+
+        cols: Dict[str, List[np.ndarray]] = {c: [] for c in fetch}
+        for start in range(0, n, bs):
+            feeds = {}
+            for input_name, col_name in feed.items():
+                col = dataset.col(col_name)
+                if col.dtype == object:
+                    batch = np.stack([np.asarray(v)
+                                      for v in col[start:start + bs]])
+                else:
+                    batch = col[start:start + bs]
+                # honor the graph's declared input dtype; otherwise keep
+                # int/bool columns intact and only downcast f64 -> f32
+                declared = graph.input_dtypes.get(input_name)
+                if declared is not None:
+                    batch = np.asarray(batch, declared)
+                elif batch.dtype == np.float64:
+                    batch = batch.astype(np.float32)
+                feeds[input_name] = np.asarray(batch)
+            fetched = self._run(feeds)
+            for out_col, tensor_name in fetch.items():
+                cols[out_col].append(np.asarray(fetched[tensor_name]))
+
+        out = dataset
+        for out_col in fetch:
+            stacked = np.concatenate(cols[out_col])
+            if stacked.ndim > 2:  # ragged-safe object column
+                obj = np.empty(len(stacked), dtype=object)
+                for i in range(len(stacked)):
+                    obj[i] = stacked[i]
+                stacked = obj
+            out = out.with_column(out_col, stacked)
+
+        import jax
+        for src, dst in (self.get("softMaxDict") or {}).items():
+            vals = np.asarray(list(out.col(src)), np.float64)
+            out = out.with_column(dst, np.asarray(
+                jax.nn.softmax(vals, axis=-1)))
+        for src, dst in (self.get("argMaxDict") or {}).items():
+            vals = np.asarray(list(out.col(src)), np.float64)
+            out = out.with_column(dst, vals.argmax(axis=-1)
+                                  .astype(np.float64))
+        return out
+
+    def slice_at_output(self, tensor_name: str,
+                        output_col: str = "output") -> "ONNXModel":
+        """New ONNXModel fetching an intermediate tensor
+        (ONNXModel.sliceAtOutputs parity)."""
+        clone = self.copy(fetchDict={output_col: tensor_name})
+        clone._graph = None
+        return clone
+
+
+class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
+    """image column -> preprocessing -> headless ONNX net -> feature
+    vector (onnx/ImageFeaturizer.scala:34)."""
+
+    onnxModel = Param("onnxModel", "the ONNXModel to run", is_complex=True)
+    headless = Param("headless", "fetch the penultimate (feature) tensor "
+                     "instead of the classifier output", is_complex=False,
+                     converter=lambda v: bool(v), default=True)
+    featureTensorName = Param("featureTensorName", "tensor to fetch in "
+                              "headless mode (default: input of the last "
+                              "node)", to_str)
+    imageHeight = Param("imageHeight", "resize height", to_int, gt(0))
+    imageWidth = Param("imageWidth", "resize width", to_int, gt(0))
+    channelOrderNCHW = Param("channelOrderNCHW", "emit NCHW float tensors",
+                             is_complex=False, converter=lambda v: bool(v),
+                             default=True)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        from mmlspark_tpu.image import ImageTransformer
+
+        onnx_model: ONNXModel = self.get("onnxModel")
+        graph = onnx_model._ensure_graph()
+
+        df = dataset
+        it = ImageTransformer(inputCol=self.get("inputCol"),
+                              outputCol="__img__",
+                              toTensor=self.get("channelOrderNCHW"))
+        if self.is_set("imageHeight") != self.is_set("imageWidth"):
+            raise ValueError("imageHeight and imageWidth must be set "
+                             "together")
+        if self.is_set("imageHeight"):
+            it = it.resize(self.get("imageHeight"), self.get("imageWidth"))
+        df = it.transform(df)
+
+        if self.get("headless"):
+            tensor = self.get("featureTensorName")
+            if not tensor:
+                last = graph.model.graph.node[-1]
+                tensor = last.input[0]
+            scorer = onnx_model.copy(
+                feedDict={graph.input_names[0]: "__img__"},
+                fetchDict={self.get("outputCol"): tensor})
+        else:
+            scorer = onnx_model.copy(
+                feedDict={graph.input_names[0]: "__img__"},
+                fetchDict={self.get("outputCol"): graph.all_output_names[0]})
+        scorer._graph = None
+        out = scorer.transform(df)
+        feats = out.col(self.get("outputCol"))
+        if feats.dtype == object:  # flatten feature maps to vectors
+            flat = np.stack([np.asarray(v).reshape(-1) for v in feats])
+            out = out.with_column(self.get("outputCol"), flat)
+        return out.drop("__img__")
+
+
+class ONNXHub:
+    """Model-zoo stub (onnx/ONNXHub.scala:72-99). The environment has no
+    egress; models must be local files."""
+
+    def __init__(self, cache_dir: Optional[str] = None):
+        self.cache_dir = cache_dir
+
+    def list_models(self):
+        raise RuntimeError(
+            "ONNXHub requires network access, which this deployment "
+            "disables; load models from local files via "
+            "ONNXModel().set_model_location(path)")
+
+    load_model = list_models

@@ -141,9 +141,9 @@ def ring_attention(q, k, v, mesh, causal: bool = False,
 
         # accumulators must be marked sp-varying for the fori_loop carry
         # (they start shard-invariant but the updates differ per shard)
-        stats0 = jax.lax.pvary(
+        stats0 = jax.lax.pcast(
             (jnp.full((b, h, nq), _NEG_INF, qc.dtype),
-             jnp.zeros((b, h, nq), qc.dtype)), (axis_name,))
+             jnp.zeros((b, h, nq), qc.dtype)), (axis_name,), to="varying")
         init = (jnp.zeros_like(qc), *stats0, kc, vc)
         out, row_max, row_sum, _, _ = jax.lax.fori_loop(0, sp, step, init)
         return out / jnp.maximum(row_sum, 1e-30).transpose(0, 2, 1)[..., None]

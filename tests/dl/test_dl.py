@@ -107,3 +107,17 @@ class TestDeepText:
                                  numHeads=2)
         out2 = fresh.transform(df)
         assert out2.col("embeddings").shape == (20, 16)
+
+
+class TestNonContiguousLabels:
+    def test_labels_not_zero_based(self):
+        """Regression: labels {1, 2} must round-trip through prediction."""
+        df = _image_df(32)
+        df = df.with_column("label", df.col("label") + 1.0)  # {1.0, 2.0}
+        model = DeepVisionClassifier(backbone="simple_cnn", batchSize=16,
+                                     maxEpochs=6, learningRate=3e-3,
+                                     labelCol="label").fit(df)
+        out = model.transform(df)
+        assert set(np.unique(out.col("prediction"))) <= {1.0, 2.0}
+        acc = (out.col("prediction") == df.col("label")).mean()
+        assert acc > 0.9

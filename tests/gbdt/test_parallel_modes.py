@@ -1,0 +1,93 @@
+"""Voting-parallel + feature-parallel tree learners on the 8-device CPU
+mesh, compared against the serial builder (LightGBM parallelism modes,
+LightGBMParams.scala:25-29)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
+from mmlspark_tpu.ops.binning import BinMapper
+from mmlspark_tpu.parallel.mesh import MeshConfig, create_mesh
+
+
+def _data(n=512, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f))
+    logit = 1.5 * x[:, 0] - x[:, 1] + 0.5 * x[:, 2]
+    y = (logit + rng.normal(size=n) * 0.3 > 0).astype(np.float64)
+    return x, y
+
+
+def _train(x, y, tree_learner, mesh=None, top_k=20, max_bin=32):
+    mapper = BinMapper.fit(x, max_bin=max_bin)
+    binned = mapper.transform(x)
+    cfg = TrainConfig(objective="binary", num_iterations=5, num_leaves=15,
+                      max_depth=4, min_data_in_leaf=5, max_bin=max_bin,
+                      tree_learner=tree_learner, top_k=top_k)
+    return train(binned, y, cfg, bin_upper=mapper.bin_upper_values(max_bin),
+                 mesh=mesh)
+
+
+@pytest.fixture(scope="module")
+def dp_mesh():
+    return create_mesh(MeshConfig(dp=8))
+
+
+@pytest.fixture(scope="module")
+def fp_mesh():
+    return create_mesh(MeshConfig(dp=1, fp=8))
+
+
+class TestFeatureParallel:
+    def test_identical_trees_to_serial(self, fp_mesh):
+        x, y = _data()
+        serial = _train(x, y, "serial")
+        feat = _train(x, y, "feature", mesh=fp_mesh)
+        # feature-parallel computes the same global histograms and the
+        # same argmax tie-break, so trees must match exactly
+        assert np.array_equal(serial.booster.split_feature,
+                              feat.booster.split_feature)
+        assert np.array_equal(serial.booster.threshold_bin,
+                              feat.booster.threshold_bin)
+        assert np.allclose(serial.booster.node_value,
+                           feat.booster.node_value, atol=1e-4)
+
+    def test_indivisible_features_raise(self, fp_mesh):
+        x, y = _data(f=6)  # 6 features, fp=8
+        with pytest.raises(ValueError, match="divisible"):
+            _train(x, y, "feature", mesh=fp_mesh)
+
+
+class TestVotingParallel:
+    def test_full_topk_matches_data_parallel(self, dp_mesh):
+        x, y = _data()
+        serial = _train(x, y, "serial")
+        # top_k >= F: every feature is a candidate -> same splits as full
+        # histogram reduction
+        voting = _train(x, y, "voting", mesh=dp_mesh, top_k=8)
+        assert np.array_equal(serial.booster.split_feature,
+                              voting.booster.split_feature)
+        assert np.array_equal(serial.booster.threshold_bin,
+                              voting.booster.threshold_bin)
+
+    def test_small_topk_still_learns(self, dp_mesh):
+        x, y = _data(n=1024, f=16, seed=3)
+        voting = _train(x, y, "voting", mesh=dp_mesh, top_k=2)
+        pred = np.asarray(voting.booster.predict_fn()(x))
+        acc = ((pred > 0) == (y > 0)).mean()
+        assert acc > 0.85  # informative features win the vote
+
+
+class TestEstimatorWiring:
+    def test_parallelism_param_routes(self, dp_mesh):
+        x, y = _data(n=256)
+        df = DataFrame({"features": x, "label": y})
+        clf = LightGBMClassifier(numIterations=3, numLeaves=7,
+                                 parallelism="voting_parallel", topK=4,
+                                 maxBin=32).set_mesh(dp_mesh)
+        model = clf.fit(df)
+        out = model.transform(df)
+        acc = (out.col("prediction") == y).mean()
+        assert acc > 0.8
