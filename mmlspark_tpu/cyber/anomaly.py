@@ -236,18 +236,29 @@ class AccessAnomaly(Estimator, _AccessAnomalyParams):
 
 
 class AccessAnomalyModel(Model, _AccessAnomalyParams):
-    user_indexer = None
-    res_indexer = None
+    # fitted indexers as complex params so save/load round-trips them
+    userIndexer = Param("userIndexer", "fitted user id indexer",
+                        is_complex=True)
+    resIndexer = Param("resIndexer", "fitted resource id indexer",
+                       is_complex=True)
+
     _u_emb: np.ndarray
     _v_emb: np.ndarray
     _u_off: Dict
     _r_off: Dict
     _norms: Dict
 
+    @property
+    def user_indexer(self):
+        return self.get("userIndexer")
+
+    @property
+    def res_indexer(self):
+        return self.get("resIndexer")
+
     def _init_state(self, u_indexer, r_indexer, u_emb, v_emb, u_off, r_off,
                     norms):
-        self.user_indexer = u_indexer
-        self.res_indexer = r_indexer
+        self._set(userIndexer=u_indexer, resIndexer=r_indexer)
         self._u_emb = u_emb
         self._v_emb = v_emb
         self._u_off = u_off

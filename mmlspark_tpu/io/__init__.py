@@ -1,0 +1,26 @@
+"""HTTP-on-DataFrame + model serving.
+
+Parity surface: reference io/http (HTTPTransformer.scala:93,
+SimpleHTTPTransformer.scala:66, HTTPSchema.scala, AsyncUtils) and Spark
+Serving (HTTPSource.scala:42,177, DistributedHTTPSource.scala:203,362,
+continuous/HTTPSourceV2.scala:80) plus the cognitive-services client
+layer (services/CognitiveServiceBase.scala:491, openai/*).
+"""
+
+from mmlspark_tpu.io.http import (
+    HTTPResponseData,
+    HTTPTransformer,
+    SimpleHTTPTransformer,
+)
+from mmlspark_tpu.io.serving import ServingServer, serve_pipeline
+from mmlspark_tpu.io.cognitive import (
+    CognitiveServiceTransformer,
+    OpenAIChatCompletion,
+    OpenAIEmbedding,
+    OpenAIPrompt,
+)
+
+__all__ = ["HTTPTransformer", "SimpleHTTPTransformer", "HTTPResponseData",
+           "ServingServer", "serve_pipeline",
+           "CognitiveServiceTransformer", "OpenAIChatCompletion",
+           "OpenAIEmbedding", "OpenAIPrompt"]

@@ -1,0 +1,154 @@
+"""Cognitive-services style HTTP transformers (incl. OpenAI).
+
+Parity: services/CognitiveServiceBase.scala:491 — a Transformer that
+turns typed params + input columns into authenticated REST calls with
+retry/backoff and a typed parsed output + error column — and the OpenAI
+family (openai/OpenAIChatCompletion.scala:22, OpenAIEmbedding.scala:24,
+OpenAIPrompt.scala:26 — prompt templating over DataFrame columns).
+
+This deployment has no egress, so ``url`` must point at a reachable
+(e.g. local) endpoint; the request/response wire format matches the
+public APIs so the same code works against real services when egress
+exists.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (
+    HasInputCol, HasOutputCol, Param, to_float, to_int, to_str,
+)
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.io.http import SimpleHTTPTransformer
+
+
+class CognitiveServiceTransformer(Transformer, HasOutputCol):
+    """Base: body built per row by ``_build_body``; subscription key /
+    bearer auth headers; JSON response parsed by ``_parse``."""
+
+    url = Param("url", "service endpoint", to_str)
+    subscriptionKey = Param("subscriptionKey", "Ocp-Apim-Subscription-Key "
+                            "header value", to_str)
+    aadToken = Param("aadToken", "Bearer token", to_str)
+    errorCol = Param("errorCol", "error column", to_str, default="errors")
+    concurrency = Param("concurrency", "max in-flight requests", to_int,
+                        default=4)
+    timeout = Param("timeout", "request timeout (s)", to_float, default=60.0)
+
+    def _headers(self) -> Dict[str, str]:
+        h: Dict[str, str] = {}
+        if self.is_set("subscriptionKey"):
+            h["Ocp-Apim-Subscription-Key"] = self.get("subscriptionKey")
+            h["api-key"] = self.get("subscriptionKey")
+        if self.is_set("aadToken"):
+            h["Authorization"] = f"Bearer {self.get('aadToken')}"
+        return h
+
+    def _build_body(self, row: Dict[str, Any]) -> Any:
+        raise NotImplementedError
+
+    def _parse(self, response: Any) -> Any:
+        return response
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        bodies = np.empty(dataset.num_rows, dtype=object)
+        for i, row in enumerate(dataset.iter_rows()):
+            bodies[i] = self._build_body(row)
+        simple = SimpleHTTPTransformer(
+            inputCol="__body__", outputCol="__parsed__",
+            errorCol=self.get("errorCol"), url=self.get("url"),
+            headers=self._headers(), concurrency=self.get("concurrency"),
+            concurrentTimeout=self.get("timeout"))
+        with_resp = simple.transform(
+            dataset.with_column("__body__", bodies))
+        parsed = np.empty(dataset.num_rows, dtype=object)
+        for i, v in enumerate(with_resp.col("__parsed__")):
+            parsed[i] = self._parse(v) if v is not None else None
+        return (dataset
+                .with_column(self.get("outputCol"), parsed)
+                .with_column(self.get("errorCol"),
+                             with_resp.col(self.get("errorCol"))))
+
+
+class OpenAIChatCompletion(CognitiveServiceTransformer):
+    """messagesCol holds [{'role','content'}...] lists
+    (OpenAIChatCompletion.scala:22)."""
+
+    messagesCol = Param("messagesCol", "chat messages column", to_str,
+                        default="messages")
+    deploymentName = Param("deploymentName", "model/deployment name", to_str)
+    temperature = Param("temperature", "sampling temperature", to_float,
+                        default=0.0)
+    maxTokens = Param("maxTokens", "max completion tokens", to_int)
+
+    def _build_body(self, row):
+        body = {"messages": list(row[self.get("messagesCol")]),
+                "temperature": self.get("temperature")}
+        if self.is_set("deploymentName"):
+            body["model"] = self.get("deploymentName")
+        if self.is_set("maxTokens"):
+            body["max_tokens"] = self.get("maxTokens")
+        return body
+
+    def _parse(self, response):
+        try:
+            return response["choices"][0]["message"]["content"]
+        except (KeyError, IndexError, TypeError):
+            return response
+
+
+class OpenAIPrompt(CognitiveServiceTransformer):
+    """promptTemplate with {colName} placeholders filled per row
+    (OpenAIPrompt.scala:26)."""
+
+    promptTemplate = Param("promptTemplate", "template with {col} "
+                           "placeholders", to_str)
+    deploymentName = Param("deploymentName", "model name", to_str)
+    temperature = Param("temperature", "sampling temperature", to_float,
+                        default=0.0)
+    systemPrompt = Param("systemPrompt", "system message", to_str)
+
+    def _build_body(self, row):
+        template = self.get("promptTemplate")
+        prompt = re.sub(r"\{(\w+)\}",
+                        lambda m: str(row.get(m.group(1), m.group(0))),
+                        template)
+        messages = []
+        if self.is_set("systemPrompt"):
+            messages.append({"role": "system",
+                             "content": self.get("systemPrompt")})
+        messages.append({"role": "user", "content": prompt})
+        body: Dict[str, Any] = {"messages": messages,
+                                "temperature": self.get("temperature")}
+        if self.is_set("deploymentName"):
+            body["model"] = self.get("deploymentName")
+        return body
+
+    def _parse(self, response):
+        try:
+            return response["choices"][0]["message"]["content"]
+        except (KeyError, IndexError, TypeError):
+            return response
+
+
+class OpenAIEmbedding(CognitiveServiceTransformer):
+    textCol = Param("textCol", "text column to embed", to_str,
+                    default="text")
+    deploymentName = Param("deploymentName", "model name", to_str)
+
+    def _build_body(self, row):
+        body = {"input": str(row[self.get("textCol")])}
+        if self.is_set("deploymentName"):
+            body["model"] = self.get("deploymentName")
+        return body
+
+    def _parse(self, response):
+        try:
+            return np.asarray(response["data"][0]["embedding"], np.float64)
+        except (KeyError, IndexError, TypeError):
+            return response

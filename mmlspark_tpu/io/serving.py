@@ -1,0 +1,166 @@
+"""Model serving: HTTP requests -> device batches -> replies.
+
+Parity: Spark Serving (SURVEY.md §3.5) — head-node mode
+(HTTPSource.scala:42 + HTTPSink.scala:177: one server, requests become
+micro-batch rows, replies matched by request id) and the continuous
+sub-ms path (HTTPSourceV2.scala:305). The distributed per-executor mode
+(DistributedHTTPSource.scala:203) maps to one ServingServer per host in
+a pod; on one host it is the same object.
+
+TPU-first design: requests are accumulated into micro-batches
+(``maxBatchSize`` rows or ``maxLatencyMs``) and scored as ONE device
+batch — the request/reply correlation the reference keeps in
+HTTPSourceStateHolder (HTTPSourceV2.scala:343) is a local dict of
+request-id -> Event.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.logging_utils import logger
+from mmlspark_tpu.core.pipeline import Transformer
+
+
+class _Pending:
+    __slots__ = ("payload", "event", "reply", "error")
+
+    def __init__(self, payload):
+        self.payload = payload
+        self.event = threading.Event()
+        self.reply = None
+        self.error = None
+
+
+class ServingServer:
+    """Serve a fitted Transformer over HTTP with micro-batched scoring."""
+
+    def __init__(self, model: Transformer, host: str = "127.0.0.1",
+                 port: int = 0, reply_col: Optional[str] = None,
+                 max_batch_size: int = 64, max_latency_ms: float = 5.0,
+                 api_path: str = "/score"):
+        self.model = model
+        self.reply_col = reply_col
+        self.max_batch_size = max_batch_size
+        self.max_latency_ms = max_latency_ms
+        self.api_path = api_path
+        self._queue: List[_Pending] = []
+        self._lock = threading.Condition()
+        self._stop = False
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def do_POST(self):
+                if self.path != server.api_path:
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    payload = json.loads(self.rfile.read(length))
+                except json.JSONDecodeError as e:
+                    self.send_error(400, f"bad json: {e}")
+                    return
+                pending = _Pending(payload)
+                with server._lock:
+                    server._queue.append(pending)
+                    server._lock.notify()
+                if not pending.event.wait(timeout=30.0):
+                    self.send_error(504, "scoring timed out")
+                    return
+                if pending.error is not None:
+                    self.send_error(500, pending.error)
+                    return
+                body = json.dumps(pending.reply).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address
+        self._server_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._batch_thread = threading.Thread(
+            target=self._batch_loop, daemon=True)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ServingServer":
+        self._server_thread.start()
+        self._batch_thread.start()
+        logger.info("serving on %s:%s%s", self.host, self.port,
+                    self.api_path)
+        return self
+
+    def stop(self) -> None:
+        self._stop = True
+        with self._lock:
+            self._lock.notify()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}{self.api_path}"
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- scoring loop --------------------------------------------------------
+    def _batch_loop(self):
+        while not self._stop:
+            with self._lock:
+                if not self._queue:
+                    self._lock.wait(timeout=0.5)
+                if not self._queue:
+                    continue
+                deadline = time.monotonic() + self.max_latency_ms / 1000.0
+                while (len(self._queue) < self.max_batch_size
+                       and time.monotonic() < deadline):
+                    self._lock.wait(timeout=max(
+                        deadline - time.monotonic(), 0.0))
+                batch = self._queue[:self.max_batch_size]
+                del self._queue[:len(batch)]
+            try:
+                self._score(batch)
+            except Exception as e:  # surface scoring errors to callers
+                for p in batch:
+                    p.error = str(e)
+                    p.event.set()
+
+    def _score(self, batch: List[_Pending]):
+        df = DataFrame.from_rows([p.payload for p in batch])
+        out = self.model.transform(df)
+        reply_cols = [self.reply_col] if self.reply_col else \
+            [c for c in out.columns if c not in df.columns] or out.columns
+        for i, p in enumerate(batch):
+            reply = {}
+            for c in reply_cols:
+                v = out.col(c)[i]
+                if isinstance(v, np.ndarray):
+                    v = v.tolist()
+                elif isinstance(v, np.generic):
+                    v = v.item()
+                reply[c] = v
+            p.reply = reply
+            p.event.set()
+
+
+def serve_pipeline(model: Transformer, **kwargs) -> ServingServer:
+    """spark.readStream.server() analog: start serving a fitted model."""
+    return ServingServer(model, **kwargs).start()

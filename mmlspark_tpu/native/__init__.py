@@ -1,0 +1,21 @@
+"""ctypes bindings for the C++ data plane, with numpy fallbacks.
+
+Parity: the reference's native loader layer (core/env NativeLoader,
+LightGBMUtils.initializeNativeLibrary, lightgbm/.../LightGBMUtils.scala:29-35)
+— a lazily-loaded shared library with a pure-JVM/Python fallback path.
+The library is built from ``native/data_plane.cpp`` by ``make`` (g++);
+:func:`ensure_built` compiles on first use and caches the .so.
+"""
+
+from mmlspark_tpu.native.bindings import (
+    NativeDataPlane,
+    bin_matrix,
+    ensure_built,
+    is_available,
+    load_csv,
+    load_libsvm,
+    murmur3_batch,
+)
+
+__all__ = ["NativeDataPlane", "ensure_built", "is_available",
+           "load_csv", "load_libsvm", "murmur3_batch", "bin_matrix"]

@@ -1,0 +1,201 @@
+"""ctypes surface of libmmlspark_native.so + numpy fallbacks."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.logging_utils import logger
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libmmlspark_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def ensure_built() -> bool:
+    """Compile the shared library if missing; returns availability."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return True
+        if _build_failed:
+            return False
+        if not os.path.exists(_SO_PATH):
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                               capture_output=True, timeout=120)
+            except Exception as e:
+                logger.warning("native build failed (%s); using numpy "
+                               "fallbacks", e)
+                _build_failed = True
+                return False
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            logger.warning("native load failed (%s); using numpy "
+                           "fallbacks", e)
+            _build_failed = True
+            return False
+        _configure(lib)
+        _lib = lib
+        return True
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    i64 = ctypes.c_int64
+    lib.mmls_murmur3_32.restype = ctypes.c_uint32
+    lib.mmls_murmur3_32.argtypes = [ctypes.c_char_p, i64, ctypes.c_uint32]
+    lib.mmls_murmur3_batch.restype = None
+    lib.mmls_murmur3_batch.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(i64), i64, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint32)]
+    lib.mmls_bin_matrix.restype = None
+    lib.mmls_bin_matrix.argtypes = [
+        ctypes.POINTER(ctypes.c_double), i64, i64,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.mmls_csv_dims.restype = ctypes.c_int
+    lib.mmls_csv_dims.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                  ctypes.POINTER(i64), ctypes.POINTER(i64)]
+    lib.mmls_csv_parse.restype = ctypes.c_int
+    lib.mmls_csv_parse.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                   ctypes.POINTER(ctypes.c_double), i64, i64]
+    lib.mmls_libsvm_dims.restype = i64
+    lib.mmls_libsvm_dims.argtypes = [ctypes.c_char_p, ctypes.POINTER(i64),
+                                     ctypes.POINTER(i64)]
+    lib.mmls_libsvm_parse.restype = ctypes.c_int
+    lib.mmls_libsvm_parse.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double), i64, i64]
+
+
+def is_available() -> bool:
+    return ensure_built()
+
+
+# ---------------------------------------------------------------------------
+# public ops (native when available, numpy otherwise)
+# ---------------------------------------------------------------------------
+
+def murmur3_batch(strings, seed: int = 0) -> np.ndarray:
+    """uint32 murmur3 of each string."""
+    if ensure_built():
+        blob = b"".join(s.encode() if isinstance(s, str) else bytes(s)
+                        for s in strings)
+        offsets = np.zeros(len(strings) + 1, np.int64)
+        pos = 0
+        for i, s in enumerate(strings):
+            pos += len(s.encode() if isinstance(s, str) else s)
+            offsets[i + 1] = pos
+        out = np.zeros(len(strings), np.uint32)
+        _lib.mmls_murmur3_batch(
+            blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(strings), seed,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+        return out
+    from mmlspark_tpu.ops.hashing import murmur3_32
+    return np.asarray([murmur3_32(s, seed) for s in strings], np.uint32)
+
+
+def bin_matrix(vals: np.ndarray, uppers: np.ndarray) -> np.ndarray:
+    """(n, f) doubles -> int32 bin ids via (f, B) upper edges."""
+    vals = np.ascontiguousarray(vals, np.float64)
+    uppers = np.ascontiguousarray(uppers, np.float64)
+    n, f = vals.shape
+    n_bins = uppers.shape[1]
+    if ensure_built():
+        out = np.zeros((n, f), np.int32)
+        _lib.mmls_bin_matrix(
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n, f,
+            uppers.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n_bins,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out
+    out = np.empty((n, f), np.int32)
+    for j in range(f):
+        out[:, j] = np.minimum(
+            np.searchsorted(uppers[j], vals[:, j], side="left"), n_bins - 1)
+    return out
+
+
+def load_csv(path: str, skip_header: bool = True
+             ) -> np.ndarray:
+    """Parse a numeric CSV into an (n, f) float64 matrix."""
+    if ensure_built():
+        i64 = ctypes.c_int64
+        rows, cols = i64(), i64()
+        rc = _lib.mmls_csv_dims(path.encode(), int(skip_header),
+                                ctypes.byref(rows), ctypes.byref(cols))
+        if rc != 0:
+            raise IOError(f"csv dims failed ({rc}) for {path}")
+        out = np.zeros((rows.value, cols.value), np.float64)
+        rc = _lib.mmls_csv_parse(
+            path.encode(), int(skip_header),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            rows.value, cols.value)
+        if rc != 0:
+            raise IOError(f"csv parse failed ({rc}) for {path}")
+        return out
+    return np.loadtxt(path, delimiter=",",
+                      skiprows=1 if skip_header else 0, ndmin=2)
+
+
+def load_libsvm(path: str, num_features: Optional[int] = None
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse libsvm lines into dense (x, y)."""
+    if ensure_built():
+        i64 = ctypes.c_int64
+        rows, maxi = i64(), i64()
+        rc = _lib.mmls_libsvm_dims(path.encode(), ctypes.byref(rows),
+                                   ctypes.byref(maxi))
+        if rc != 0:
+            raise IOError(f"libsvm dims failed ({rc}) for {path}")
+        f = num_features or maxi.value
+        x = np.zeros((rows.value, f), np.float64)
+        y = np.zeros(rows.value, np.float64)
+        rc = _lib.mmls_libsvm_parse(
+            path.encode(),
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            y.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            rows.value, f)
+        if rc != 0:
+            raise IOError(f"libsvm parse failed ({rc}) for {path}")
+        return x, y
+    xs, ys, maxf = [], [], 0
+    with open(path) as fh:
+        for line in fh:
+            parts = line.split()
+            if not parts:
+                continue
+            ys.append(float(parts[0]))
+            row = {}
+            for kv in parts[1:]:
+                k, v = kv.split(":")
+                row[int(k)] = float(v)
+                maxf = max(maxf, int(k))
+            xs.append(row)
+    f = num_features or maxf
+    x = np.zeros((len(xs), f), np.float64)
+    for i, row in enumerate(xs):
+        for k, v in row.items():
+            if 1 <= k <= f:
+                x[i, k - 1] = v
+    return x, np.asarray(ys)
+
+
+class NativeDataPlane:
+    """Facade used by DataFrame readers and BinMapper."""
+
+    is_available = staticmethod(is_available)
+    load_csv = staticmethod(load_csv)
+    load_libsvm = staticmethod(load_libsvm)
+    murmur3_batch = staticmethod(murmur3_batch)
+    bin_matrix = staticmethod(bin_matrix)

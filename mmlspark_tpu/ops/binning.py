@@ -114,6 +114,10 @@ class BinMapper:
     def transform(self, x: np.ndarray) -> np.ndarray:
         """Map raw features (N, F) to bin ids (N, F) int32; NaN -> bin 0."""
         x = np.asarray(x, dtype=np.float64)
+        if not any(self.is_categorical):
+            native = self._transform_native(x)
+            if native is not None:
+                return native
         out = np.zeros(x.shape, dtype=np.int32)
         for f in range(self.num_features):
             col = x[:, f]
@@ -127,6 +131,25 @@ class BinMapper:
                 b = np.searchsorted(self.upper_edges[f], col, side="left") + 1
             out[:, f] = np.where(nan, 0, b)
         return out
+
+    def _transform_native(self, x: np.ndarray) -> "np.ndarray | None":
+        """Multithreaded C++ binning (native/data_plane.cpp
+        mmls_bin_matrix); returns None when the library is unavailable."""
+        from mmlspark_tpu.native.bindings import bin_matrix, is_available
+
+        if not is_available():
+            return None
+        # pad per-feature edges to one (F, maxlen+1) inf-padded matrix so
+        # lower_bound never hits the clamp for in-range values
+        maxlen = max((len(e) for e in self.upper_edges), default=0) + 1
+        padded = np.full((self.num_features, maxlen), np.inf)
+        for f in range(self.num_features):
+            padded[f, :len(self.upper_edges[f])] = self.upper_edges[f]
+        nan_mask = np.isnan(x)
+        safe = np.where(nan_mask, -np.inf, x)
+        bins = bin_matrix(safe, padded) + 1  # bin 0 is the missing bin
+        bins[nan_mask] = 0
+        return bins.astype(np.int32)
 
     def bin_upper_values(self, total_bins: int) -> np.ndarray:
         """(F, total_bins) raw-value upper bound per bin — lets a trained

@@ -1,0 +1,314 @@
+// Native data plane: CSV/libsvm ingest, murmur3 feature hashing, and
+// quantile binning.
+//
+// Role parity: the reference's hot data paths live in native engines —
+// LightGBM's Dataset construction/binning (lightgbmlib LGBM_Dataset*),
+// VW's murmur feature hashing (vw-jni), and the row marshaling loops
+// (StreamingPartitionTask.scala:203-277). Here the same stages run as a
+// multithreaded C++ library feeding numpy buffers that go straight to
+// the TPU via jnp.asarray; Python fallbacks exist for environments
+// without a compiler (mmlspark_tpu/native/__init__.py).
+//
+// Exposed via a plain C ABI for ctypes (no pybind11 in the image).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+int hardware_threads() {
+  unsigned n = std::thread::hardware_concurrency();
+  return n ? static_cast<int>(n) : 4;
+}
+
+// parallel-for over [0, n) in contiguous chunks
+template <typename F>
+void parallel_chunks(int64_t n, F&& fn) {
+  int workers = std::min<int64_t>(hardware_threads(), std::max<int64_t>(n, 1));
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + workers - 1) / workers;
+  for (int w = 0; w < workers; ++w) {
+    int64_t lo = w * chunk;
+    int64_t hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    threads.emplace_back([lo, hi, &fn] { fn(lo, hi); });
+  }
+  for (auto& t : threads) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// murmur3_32 (public algorithm; VW-compatible hashing of feature names)
+// ---------------------------------------------------------------------------
+uint32_t mmls_murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
+  const uint32_t c1 = 0xcc9e2d51u, c2 = 0x1b873593u;
+  uint32_t h = seed;
+  const int64_t nblocks = len / 4;
+  for (int64_t i = 0; i < nblocks; ++i) {
+    uint32_t k;
+    std::memcpy(&k, data + i * 4, 4);
+    k *= c1;
+    k = (k << 15) | (k >> 17);
+    k *= c2;
+    h ^= k;
+    h = (h << 13) | (h >> 19);
+    h = h * 5 + 0xe6546b64u;
+  }
+  uint32_t k = 0;
+  const uint8_t* tail = data + nblocks * 4;
+  switch (len & 3) {
+    case 3: k ^= static_cast<uint32_t>(tail[2]) << 16; [[fallthrough]];
+    case 2: k ^= static_cast<uint32_t>(tail[1]) << 8; [[fallthrough]];
+    case 1:
+      k ^= tail[0];
+      k *= c1;
+      k = (k << 15) | (k >> 17);
+      k *= c2;
+      h ^= k;
+  }
+  h ^= static_cast<uint32_t>(len);
+  h ^= h >> 16;
+  h *= 0x85ebca6bu;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35u;
+  h ^= h >> 16;
+  return h;
+}
+
+// hash a batch of NUL-separated strings; offsets[i] is the byte offset of
+// string i in `blob`, offsets[n] the total length
+void mmls_murmur3_batch(const uint8_t* blob, const int64_t* offsets,
+                        int64_t n, uint32_t seed, uint32_t* out) {
+  parallel_chunks(n, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      out[i] = mmls_murmur3_32(blob + offsets[i],
+                               offsets[i + 1] - offsets[i], seed);
+    }
+  });
+}
+
+// ---------------------------------------------------------------------------
+// quantile binning: values -> bin ids via upper-edge binary search
+// (the reference's LGBM_DatasetCreateFromSampledColumn bin mapping role)
+// ---------------------------------------------------------------------------
+void mmls_bin_column(const double* vals, int64_t n, const double* uppers,
+                     int32_t n_bins, int32_t* out) {
+  parallel_chunks(n, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      double v = vals[i];
+      const double* pos = std::lower_bound(uppers, uppers + n_bins, v);
+      int32_t b = static_cast<int32_t>(pos - uppers);
+      out[i] = std::min(b, n_bins - 1);
+    }
+  });
+}
+
+// bin a whole (n, f) column-major-agnostic matrix: vals row-major,
+// uppers (f, n_bins) row-major
+void mmls_bin_matrix(const double* vals, int64_t n, int64_t f,
+                     const double* uppers, int32_t n_bins, int32_t* out) {
+  parallel_chunks(n, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      for (int64_t j = 0; j < f; ++j) {
+        double v = vals[i * f + j];
+        const double* u = uppers + j * n_bins;
+        const double* pos = std::lower_bound(u, u + n_bins, v);
+        int32_t b = static_cast<int32_t>(pos - u);
+        out[i * f + j] = std::min(b, n_bins - 1);
+      }
+    }
+  });
+}
+
+// ---------------------------------------------------------------------------
+// CSV ingest (double matrix). Two-pass: size, then parallel parse by
+// line index. Returns 0 on success.
+// ---------------------------------------------------------------------------
+int mmls_csv_dims(const char* path, int skip_header, int64_t* n_rows,
+                  int64_t* n_cols) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return 1;
+  std::fseek(fp, 0, SEEK_END);
+  long size = std::ftell(fp);
+  std::fseek(fp, 0, SEEK_SET);
+  std::vector<char> buf(size);
+  if (size && std::fread(buf.data(), 1, size, fp) != (size_t)size) {
+    std::fclose(fp);
+    return 2;
+  }
+  std::fclose(fp);
+  int64_t rows = 0, cols = 1;
+  bool counted_cols = false;
+  bool in_first_data_line = true;
+  int skipped = 0;
+  for (long i = 0; i < size; ++i) {
+    if (skipped < skip_header) {
+      if (buf[i] == '\n') ++skipped;
+      continue;
+    }
+    if (!counted_cols && buf[i] == ',') ++cols;
+    if (buf[i] == '\n') {
+      counted_cols = true;
+      ++rows;
+    }
+  }
+  if (size > 0 && buf[size - 1] != '\n' && skipped >= skip_header) ++rows;
+  *n_rows = rows;
+  *n_cols = cols;
+  return 0;
+}
+
+int mmls_csv_parse(const char* path, int skip_header, double* out,
+                   int64_t n_rows, int64_t n_cols) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return 1;
+  std::fseek(fp, 0, SEEK_END);
+  long size = std::ftell(fp);
+  std::fseek(fp, 0, SEEK_SET);
+  std::vector<char> buf(size + 1);
+  if (size && std::fread(buf.data(), 1, size, fp) != (size_t)size) {
+    std::fclose(fp);
+    return 2;
+  }
+  std::fclose(fp);
+  buf[size] = '\0';
+
+  // index line starts
+  std::vector<const char*> lines;
+  lines.reserve(n_rows);
+  const char* p = buf.data();
+  const char* end = buf.data() + size;
+  int skipped = 0;
+  while (p < end && skipped < skip_header) {
+    if (*p == '\n') ++skipped;
+    ++p;
+  }
+  while (p < end && static_cast<int64_t>(lines.size()) < n_rows) {
+    lines.push_back(p);
+    while (p < end && *p != '\n') ++p;
+    ++p;
+  }
+  if (static_cast<int64_t>(lines.size()) != n_rows) return 3;
+
+  std::atomic<int> err{0};
+  parallel_chunks(n_rows, [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      const char* q = lines[r];
+      for (int64_t c = 0; c < n_cols; ++c) {
+        char* next = nullptr;
+        out[r * n_cols + c] = std::strtod(q, &next);
+        if (next == q && !(*q == ',' || *q == '\n')) {
+          err.store(4);
+        }
+        q = next;
+        while (*q == ',' || *q == ' ') ++q;
+      }
+    }
+  });
+  return err.load();
+}
+
+// ---------------------------------------------------------------------------
+// libsvm ingest -> dense matrix ("label idx:val idx:val ...")
+// ---------------------------------------------------------------------------
+int mmls_libsvm_parse(const char* path, double* x, double* y,
+                      int64_t n_rows, int64_t n_cols) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return 1;
+  std::fseek(fp, 0, SEEK_END);
+  long size = std::ftell(fp);
+  std::fseek(fp, 0, SEEK_SET);
+  std::vector<char> buf(size + 1);
+  if (size && std::fread(buf.data(), 1, size, fp) != (size_t)size) {
+    std::fclose(fp);
+    return 2;
+  }
+  std::fclose(fp);
+  buf[size] = '\0';
+
+  std::vector<const char*> lines;
+  lines.reserve(n_rows);
+  const char* p = buf.data();
+  const char* end = buf.data() + size;
+  while (p < end && static_cast<int64_t>(lines.size()) < n_rows) {
+    lines.push_back(p);
+    while (p < end && *p != '\n') ++p;
+    ++p;
+  }
+  if (static_cast<int64_t>(lines.size()) != n_rows) return 3;
+
+  std::memset(x, 0, sizeof(double) * n_rows * n_cols);
+  std::atomic<int> err{0};
+  parallel_chunks(n_rows, [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      const char* q = lines[r];
+      char* next = nullptr;
+      y[r] = std::strtod(q, &next);
+      q = next;
+      while (*q && *q != '\n') {
+        while (*q == ' ') ++q;
+        if (*q == '\n' || *q == '\0') break;
+        long idx = std::strtol(q, &next, 10);
+        if (*next != ':') {
+          err.store(4);
+          break;
+        }
+        q = next + 1;
+        double val = std::strtod(q, &next);
+        q = next;
+        if (idx >= 1 && idx <= n_cols) x[r * n_cols + (idx - 1)] = val;
+      }
+    }
+  });
+  return err.load();
+}
+
+int64_t mmls_libsvm_dims(const char* path, int64_t* n_rows,
+                         int64_t* max_index) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return 1;
+  std::fseek(fp, 0, SEEK_END);
+  long size = std::ftell(fp);
+  std::fseek(fp, 0, SEEK_SET);
+  std::vector<char> buf(size + 1);
+  if (size && std::fread(buf.data(), 1, size, fp) != (size_t)size) {
+    std::fclose(fp);
+    return 2;
+  }
+  std::fclose(fp);
+  buf[size] = '\0';
+  int64_t rows = 0, maxi = 0;
+  const char* p = buf.data();
+  const char* end = buf.data() + size;
+  while (p < end) {
+    const char* line_end = static_cast<const char*>(
+        std::memchr(p, '\n', end - p));
+    if (!line_end) line_end = end;
+    if (line_end > p) ++rows;
+    const char* q = p;
+    while (q < line_end) {
+      if (*q == ':') {
+        const char* b = q;
+        while (b > p && (b[-1] >= '0' && b[-1] <= '9')) --b;
+        long idx = std::strtol(b, nullptr, 10);
+        if (idx > maxi) maxi = idx;
+      }
+      ++q;
+    }
+    p = line_end + 1;
+  }
+  *n_rows = rows;
+  *max_index = maxi;
+  return 0;
+}
+
+}  // extern "C"

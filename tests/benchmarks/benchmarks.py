@@ -1,0 +1,76 @@
+"""Accuracy-regression harness.
+
+Parity: core test ``Benchmarks`` trait
+(core/src/test/scala/.../benchmarks/Benchmarks.scala:15-70): named
+metric values are compared against a committed CSV with per-metric
+tolerance; on mismatch the observed values are written next to the
+expected file as ``new_benchmarks_<name>.csv`` so a human can diff and
+promote them.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Dict, List, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_RESOURCES = os.path.join(_HERE, "resources")
+
+
+class Benchmarks:
+    def __init__(self, name: str):
+        self.name = name
+        self.rows: List[Tuple[str, float, float]] = []  # (key, value, tol)
+
+    def add(self, key: str, value: float, tolerance: float = 1e-6
+            ) -> "Benchmarks":
+        self.rows.append((key, float(value), float(tolerance)))
+        return self
+
+    @property
+    def expected_path(self) -> str:
+        return os.path.join(_RESOURCES, f"benchmarks_{self.name}.csv")
+
+    @property
+    def observed_path(self) -> str:
+        return os.path.join(_RESOURCES, f"new_benchmarks_{self.name}.csv")
+
+    def _write(self, path: str) -> None:
+        os.makedirs(_RESOURCES, exist_ok=True)
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["key", "value", "tolerance"])
+            for key, value, tol in self.rows:
+                w.writerow([key, f"{value:.6f}", tol])
+
+    def verify(self) -> None:
+        """Compare against the committed CSV; write observed values and
+        raise on drift. A missing expected file writes it and fails so
+        the author commits it deliberately (Benchmarks.scala semantics)."""
+        if not os.path.exists(self.expected_path):
+            self._write(self.expected_path)
+            raise AssertionError(
+                f"no committed benchmark for {self.name}; wrote "
+                f"{self.expected_path} — review and commit it")
+        expected: Dict[str, Tuple[float, float]] = {}
+        with open(self.expected_path, newline="") as f:
+            for row in csv.DictReader(f):
+                expected[row["key"]] = (float(row["value"]),
+                                        float(row["tolerance"]))
+        errors = []
+        for key, value, _ in self.rows:
+            if key not in expected:
+                errors.append(f"unexpected new metric {key!r}")
+                continue
+            want, tol = expected[key]
+            if abs(value - want) > tol:
+                errors.append(
+                    f"{key}: got {value:.6f}, expected {want:.6f} ±{tol}")
+        missing = set(expected) - {k for k, _, _ in self.rows}
+        errors.extend(f"metric {k!r} not produced" for k in missing)
+        if errors:
+            self._write(self.observed_path)
+            raise AssertionError(
+                f"benchmark drift for {self.name} (observed values written "
+                f"to {self.observed_path}):\n  " + "\n  ".join(errors))

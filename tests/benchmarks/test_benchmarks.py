@@ -1,0 +1,82 @@
+"""Accuracy regression vs committed CSVs, patterned on the reference's
+benchmarks_VerifyLightGBMClassifier*.csv /
+benchmarks_VerifyVowpalWabbitRegressor.csv suites (SURVEY.md §4.3).
+
+Datasets are deterministic synthetics, so the metric values are exact
+fingerprints of the training algorithms: any numerical change to
+histogram building, split selection, objectives or SGD shows up here.
+"""
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.models.gbdt.estimators import (
+    LightGBMClassifier,
+    LightGBMRegressor,
+)
+from mmlspark_tpu.models.vw.learners import VowpalWabbitRegressor
+from mmlspark_tpu.train.statistics import ComputeModelStatistics
+
+from .benchmarks import Benchmarks
+
+
+def _cls_data(n=400, seed=11):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 6))
+    logit = 1.2 * x[:, 0] - 0.8 * x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
+    y = (logit + rng.normal(size=n) * 0.4 > 0).astype(np.float64)
+    return DataFrame({"features": x, "label": y})
+
+
+def _reg_data(n=400, seed=13):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 6))
+    y = 2.0 * x[:, 0] - x[:, 1] + 0.3 * x[:, 2] ** 2 \
+        + rng.normal(size=n) * 0.2
+    return DataFrame({"features": x, "label": y})
+
+
+def _auc(model, df) -> float:
+    scored = model.transform(df)
+    stats = ComputeModelStatistics(
+        labelCol="label", scoresCol="probability",
+        evaluationMetric="AUC").transform(scored)
+    return float(stats.col("AUC")[0])
+
+
+def _l2(model, df) -> float:
+    pred = model.transform(df).col("prediction")
+    return float(np.mean((pred - df.col("label")) ** 2))
+
+
+def test_lightgbm_classifier_benchmarks():
+    df = _cls_data()
+    bench = Benchmarks("VerifyLightGBMClassifier")
+    for boosting in ("gbdt", "rf", "dart", "goss"):
+        clf = LightGBMClassifier(numIterations=10, numLeaves=15, maxBin=64,
+                                 boostingType=boosting, seed=3,
+                                 baggingFraction=0.8, baggingFreq=1)
+        bench.add(f"auc_{boosting}", _auc(clf.fit(df), df), tolerance=0.01)
+    bench.verify()
+
+
+def test_lightgbm_regressor_benchmarks():
+    df = _reg_data()
+    bench = Benchmarks("VerifyLightGBMRegressor")
+    for boosting in ("gbdt", "rf", "dart", "goss"):
+        reg = LightGBMRegressor(numIterations=10, numLeaves=15, maxBin=64,
+                                boostingType=boosting, seed=3,
+                                baggingFraction=0.8, baggingFreq=1)
+        bench.add(f"l2_{boosting}", _l2(reg.fit(df), df), tolerance=0.05)
+    bench.verify()
+
+
+def test_vw_regressor_benchmarks():
+    df = _reg_data()
+    bench = Benchmarks("VerifyVowpalWabbitRegressor")
+    base = VowpalWabbitRegressor(numPasses=6, learningRate=0.5, seed=5,
+                                 batchSize=16)
+    bench.add("l2_default", _l2(base.fit(df), df), tolerance=0.05)
+    adaptive = base.copy(adaptive=True)
+    bench.add("l2_adaptive", _l2(adaptive.fit(df), df), tolerance=0.05)
+    bench.verify()

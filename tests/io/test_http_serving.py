@@ -1,0 +1,198 @@
+"""io tests with real localhost servers, patterned on the reference's
+HTTPTransformerSuite / HTTPv2Suite (core io tests run against live local
+endpoints, SURVEY.md §4.5)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.io import (
+    HTTPTransformer,
+    OpenAIChatCompletion,
+    OpenAIPrompt,
+    ServingServer,
+    SimpleHTTPTransformer,
+)
+
+
+class _EchoHandler(BaseHTTPRequestHandler):
+    flaky_counter = {"n": 0}
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(length)) if length else None
+        if self.path == "/echo":
+            reply = {"echo": body}
+        elif self.path == "/flaky":
+            _EchoHandler.flaky_counter["n"] += 1
+            if _EchoHandler.flaky_counter["n"] % 2 == 1:
+                self.send_error(503)
+                return
+            reply = {"ok": True, "attempt": _EchoHandler.flaky_counter["n"]}
+        elif self.path == "/chat":
+            text = body["messages"][-1]["content"]
+            reply = {"choices": [{"message": {
+                "role": "assistant", "content": f"reply to: {text}"}}]}
+        else:
+            self.send_error(404)
+            return
+        data = json.dumps(reply).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+@pytest.fixture(scope="module")
+def echo_server():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _EchoHandler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    host, port = httpd.server_address
+    yield f"http://{host}:{port}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+class TestHTTPTransformer:
+    def test_concurrent_requests(self, echo_server):
+        reqs = np.empty(6, dtype=object)
+        for i in range(6):
+            reqs[i] = {"url": f"{echo_server}/echo", "method": "POST",
+                       "headers": {"Content-Type": "application/json"},
+                       "body": json.dumps({"i": i})}
+        df = DataFrame({"request": reqs})
+        out = HTTPTransformer(inputCol="request", outputCol="response",
+                              concurrency=4).transform(df)
+        for i, resp in enumerate(out.col("response")):
+            assert resp.status_code == 200
+            assert json.loads(resp.entity) == {"echo": {"i": i}}
+
+    def test_retry_on_503(self, echo_server):
+        _EchoHandler.flaky_counter["n"] = 0
+        reqs = np.empty(1, dtype=object)
+        reqs[0] = {"url": f"{echo_server}/flaky", "method": "POST",
+                   "body": "{}"}
+        out = HTTPTransformer(inputCol="r", outputCol="resp",
+                              backoffs=[0.01, 0.01]).transform(
+            DataFrame({"r": reqs}))
+        assert out.col("resp")[0].status_code == 200
+
+    def test_404_surfaces(self, echo_server):
+        reqs = np.empty(1, dtype=object)
+        reqs[0] = {"url": f"{echo_server}/nope", "method": "POST",
+                   "body": "{}"}
+        out = HTTPTransformer(inputCol="r", outputCol="resp",
+                              backoffs=[]).transform(DataFrame({"r": reqs}))
+        assert out.col("resp")[0].status_code == 404
+
+
+class TestSimpleHTTPTransformer:
+    def test_json_in_out(self, echo_server):
+        payloads = np.empty(3, dtype=object)
+        for i in range(3):
+            payloads[i] = {"value": i}
+        df = DataFrame({"input": payloads})
+        out = SimpleHTTPTransformer(
+            inputCol="input", outputCol="parsed",
+            url=f"{echo_server}/echo").transform(df)
+        assert out.col("parsed")[1] == {"echo": {"value": 1}}
+        assert all(e is None for e in out.col("errors"))
+
+    def test_error_column(self, echo_server):
+        payloads = np.empty(1, dtype=object)
+        payloads[0] = {"x": 1}
+        out = SimpleHTTPTransformer(
+            inputCol="input", outputCol="parsed", backoffs=[],
+            url=f"{echo_server}/missing").transform(
+            DataFrame({"input": payloads}))
+        assert out.col("parsed")[0] is None
+        assert out.col("errors")[0]["statusCode"] == 404
+
+
+class TestCognitive:
+    def test_chat_completion(self, echo_server):
+        msgs = np.empty(2, dtype=object)
+        msgs[0] = [{"role": "user", "content": "hello"}]
+        msgs[1] = [{"role": "user", "content": "world"}]
+        df = DataFrame({"messages": msgs})
+        chat = OpenAIChatCompletion(url=f"{echo_server}/chat",
+                                    subscriptionKey="k",
+                                    outputCol="completion")
+        out = chat.transform(df)
+        assert out.col("completion")[0] == "reply to: hello"
+        assert out.col("completion")[1] == "reply to: world"
+
+    def test_prompt_templating(self, echo_server):
+        df = DataFrame({"product": np.asarray(["widget", "gadget"],
+                                              dtype=object)})
+        prompt = OpenAIPrompt(url=f"{echo_server}/chat",
+                              promptTemplate="Describe a {product}",
+                              outputCol="description")
+        out = prompt.transform(df)
+        assert out.col("description")[0] == "reply to: Describe a widget"
+
+
+class _DoubleModel(Transformer):
+    def _transform(self, df):
+        return df.with_column("doubled", np.asarray(df.col("x")) * 2.0)
+
+
+class TestServing:
+    def test_serve_scores_and_batches(self):
+        import urllib.request
+
+        with ServingServer(_DoubleModel(), max_latency_ms=20) as server:
+            def call(x):
+                req = urllib.request.Request(
+                    server.url, data=json.dumps({"x": x}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return json.loads(r.read())
+
+            # concurrent calls get micro-batched into one device batch
+            results = {}
+            threads = [threading.Thread(
+                target=lambda i=i: results.update({i: call(float(i))}))
+                for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for i in range(8):
+                assert results[i] == {"doubled": 2.0 * i}
+
+    def test_bad_json_400(self):
+        import urllib.error
+        import urllib.request
+
+        with ServingServer(_DoubleModel()) as server:
+            req = urllib.request.Request(server.url, data=b"not json")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 400
+
+    def test_scoring_error_500(self):
+        import urllib.error
+        import urllib.request
+
+        class _Boom(Transformer):
+            def _transform(self, df):
+                raise RuntimeError("kaboom")
+
+        with ServingServer(_Boom()) as server:
+            req = urllib.request.Request(
+                server.url, data=json.dumps({"x": 1}).encode())
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 500
