@@ -6,20 +6,9 @@ The reference tests multi-node behavior on a single JVM via ``local[*]``
 for real without TPU hardware. Must run before jax initializes.
 """
 
-import os
+from mmlspark_tpu.core.virtual_devices import force_cpu_devices
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-
-# The image's sitecustomize imports jax (axon TPU plugin) before conftest
-# runs, so the env vars above may be read too late — force via config too.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+force_cpu_devices(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
