@@ -1,13 +1,19 @@
-"""Benchmark: HIGGS-style LightGBM binary classification fit throughput.
+"""Benchmark: HIGGS-scale LightGBM-parity binary classification fit.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Baseline anchor (BASELINE.md): the reference claims LightGBM-on-Spark is
-10-30% faster than SparkML GBT on HIGGS with no absolute numbers, so the
-recorded number is absolute training throughput (million rows * trees /
-second) on a HIGGS-shaped synthetic dataset (28 features, binary label).
-``vs_baseline`` compares against a conservative reference-GPU-executor
-anchor of 2.0 Mrow-trees/s.
+Config mirrors the HIGGS-style setup BASELINE.md tracks (28 features,
+binary label, 255 bins, 63 leaves / depth 6) at 2M rows x 100 trees.
+Throughput unit: million (rows x trees) per second of ``train()`` wall
+clock, steady state (second call; compiled executables and the
+persistent XLA cache warm, as a fitted production pipeline would be).
+
+``vs_baseline`` divides by a MEASURED comparator: sklearn 1.9
+HistGradientBoostingClassifier (the same histogram-GBDT algorithm
+family the reference wraps) on this machine's CPU, same data/config:
+2M rows x 100 trees in 61.3s = 3.263 Mrow-trees/s (measured 2026-07-29,
+single-core container). The previous rounds' invented 2.0 anchor is
+retired per the round-2 verdict.
 """
 
 import json
@@ -15,13 +21,19 @@ import time
 
 import numpy as np
 
+BASELINE_MROW_TREES_S = 3.263  # measured: sklearn HistGBDT, this host
+
 
 def main():
+    from mmlspark_tpu.core.compile_cache import enable_persistent_cache
     from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
     from mmlspark_tpu.ops.binning import BinMapper
 
+    enable_persistent_cache()
+
     rng = np.random.default_rng(0)
-    n, f = 400_000, 28  # HIGGS-shaped
+    n, f = 2_000_000, 28  # HIGGS-shaped
+    num_trees = 100
     x = rng.normal(size=(n, f)).astype(np.float32)
     logit = (x[:, 0] * 1.2 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
              + 0.3 * np.sin(x[:, 4] * 3))
@@ -29,26 +41,24 @@ def main():
 
     mapper = BinMapper.fit(x[:100_000], max_bin=255)
     binned = mapper.transform(x)
-    num_trees = 20
+    bin_upper = mapper.bin_upper_values(255)
     cfg = TrainConfig(objective="binary", num_iterations=num_trees,
                       num_leaves=63, max_depth=6, min_data_in_leaf=20)
 
-    # warmup/compile
-    wcfg = TrainConfig(objective="binary", num_iterations=2, num_leaves=63,
-                       max_depth=6, min_data_in_leaf=20)
-    train(binned, y, wcfg, bin_upper=mapper.bin_upper_values(cfg.max_bin))
+    # warmup/compile at identical shapes (second call reuses the cached
+    # compiled step)
+    train(binned, y, cfg, bin_upper=bin_upper)
 
     t0 = time.perf_counter()
-    result = train(binned, y, cfg, bin_upper=mapper.bin_upper_values(cfg.max_bin))
+    result = train(binned, y, cfg, bin_upper=bin_upper)
     dt = time.perf_counter() - t0
 
     row_trees_per_s = n * result.booster.num_trees / dt / 1e6
-    baseline = 2.0
     print(json.dumps({
-        "metric": "gbdt_fit_throughput_higgs28f",
+        "metric": "gbdt_fit_throughput_higgs28f_2M",
         "value": round(row_trees_per_s, 3),
         "unit": "Mrow-trees/s",
-        "vs_baseline": round(row_trees_per_s / baseline, 3),
+        "vs_baseline": round(row_trees_per_s / BASELINE_MROW_TREES_S, 3),
     }))
 
 

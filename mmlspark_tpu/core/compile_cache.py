@@ -1,0 +1,49 @@
+"""Persistent XLA compilation cache.
+
+The test suite and benchmarks are dominated by XLA compiles (the
+reference copes with CI wall-clock via suite sharding, SURVEY.md §4;
+here the analog is caching compiled executables across processes).
+Enable early — before the first ``jit`` call — so every compilation
+with a compile time above the threshold is persisted and reloaded.
+"""
+
+import hashlib
+import os
+import platform
+
+# Key the default cache dir by machine identity: XLA:CPU AOT executables
+# are ISA-specific, and loading an entry compiled on a different machine
+# can SIGILL. platform.machine() only separates arch families, so fold in
+# the CPU feature flags (ISA extensions) where the OS exposes them.
+
+
+def _cpu_features() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    return " ".join(sorted(line.split(":", 1)[1].split()))
+    except OSError:
+        pass
+    return platform.processor()
+
+
+_MACHINE_TAG = hashlib.sha1(
+    f"{platform.machine()}|{platform.system()}|{_cpu_features()}"
+    .encode()).hexdigest()[:12]
+DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".cache",
+                           f"mmlspark_tpu_xla_{_MACHINE_TAG}")
+
+
+def enable_persistent_cache(path: str = None) -> str:
+    """Point JAX's persistent compilation cache at ``path`` (created if
+    missing). Returns the directory used. Safe to call more than once."""
+    import jax
+
+    cache_dir = path or os.environ.get("MMLSPARK_TPU_COMPILE_CACHE",
+                                       DEFAULT_DIR)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache_dir

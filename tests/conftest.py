@@ -6,9 +6,11 @@ The reference tests multi-node behavior on a single JVM via ``local[*]``
 for real without TPU hardware. Must run before jax initializes.
 """
 
+from mmlspark_tpu.core.compile_cache import enable_persistent_cache
 from mmlspark_tpu.core.virtual_devices import force_cpu_devices
 
 force_cpu_devices(8)
+enable_persistent_cache()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -194,3 +194,33 @@ def test_high_cardinality_categorical():
     model = LightGBMClassifier(numIterations=5, minDataInLeaf=5,
                                categoricalSlotIndexes=[0], maxBin=64).fit(df)
     assert model.booster.num_trees == 5
+
+
+def test_zero_iterations_returns_empty_booster():
+    from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
+    from mmlspark_tpu.ops.binning import BinMapper
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(50, 3))
+    y = (x[:, 0] > 0).astype(np.float64)
+    mapper = BinMapper.fit(x, max_bin=16)
+    cfg = TrainConfig(objective="binary", num_iterations=0, max_bin=16)
+    res = train(mapper.transform(x), y, cfg)
+    assert res.booster.num_trees == 0
+    assert res.evals == []
+
+
+def test_callbacks_called_live_per_iteration():
+    from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
+    from mmlspark_tpu.ops.binning import BinMapper
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(200, 4))
+    y = (x[:, 0] > 0).astype(np.float64)
+    mapper = BinMapper.fit(x, max_bin=16)
+    cfg = TrainConfig(objective="binary", num_iterations=5, num_leaves=7,
+                      max_depth=3, min_data_in_leaf=5, max_bin=16)
+    seen = []
+    train(mapper.transform(x), y, cfg,
+          callbacks=[lambda it, rec: seen.append((it, rec["iteration"]))])
+    assert seen == [(i, i) for i in range(5)]

@@ -173,3 +173,30 @@ def test_quantile_metric_uses_cfg_alpha():
     expected = float(M.quantile_loss(jnp.asarray(raw), jnp.asarray(y),
                                      alpha=0.9))
     assert res.evals[-1]["train_quantile"] == pytest.approx(expected, rel=1e-4)
+
+
+def test_custom_objective_host_numpy():
+    """Custom objectives may be plain numpy functions (FObjTrait analog,
+    lightgbm/.../FObjTrait.scala:1): the eager path must call them with
+    concrete arrays, not tracers."""
+    from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
+    from mmlspark_tpu.ops.binning import BinMapper
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(300, 4))
+    y = 2.0 * x[:, 0] - x[:, 1] + rng.normal(size=300) * 0.1
+
+    def np_l2(preds, labels, weights=None):
+        p = np.asarray(preds)  # raises on tracers: proves eager call
+        return p - np.asarray(labels), np.ones_like(p)
+
+    mapper = BinMapper.fit(x, max_bin=32)
+    cfg = TrainConfig(objective="regression", num_iterations=15,
+                      num_leaves=15, max_depth=4, min_data_in_leaf=5,
+                      max_bin=32)
+    res = train(mapper.transform(x), y, cfg,
+                bin_upper=mapper.bin_upper_values(32),
+                custom_objective=np_l2)
+    pred = res.booster.predict_jit()(x)
+    r2 = 1 - np.sum((np.asarray(pred) - y) ** 2) / np.sum((y - y.mean()) ** 2)
+    assert r2 > 0.8, r2
