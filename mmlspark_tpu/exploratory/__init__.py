@@ -1,0 +1,9 @@
+"""Responsible-AI exploratory data balance measures (reference:
+core/.../exploratory/)."""
+
+from mmlspark_tpu.exploratory.balance import (AggregateBalanceMeasure,
+                                              DistributionBalanceMeasure,
+                                              FeatureBalanceMeasure)
+
+__all__ = ["AggregateBalanceMeasure", "DistributionBalanceMeasure",
+           "FeatureBalanceMeasure"]

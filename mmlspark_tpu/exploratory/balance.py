@@ -1,0 +1,316 @@
+"""Responsible-AI data balance measures.
+
+Parity with the reference's exploratory module
+(core/.../exploratory/FeatureBalanceMeasure.scala:1,
+DistributionBalanceMeasure.scala:1, AggregateBalanceMeasure.scala:1):
+three transformers that measure how balanced a dataset is along
+sensitive feature columns. Group counting happens once on host
+(``DataFrame.group_indices``); the measure math is vectorized float64
+numpy over the (group-cardinality-sized) count arrays — these are tiny
+aggregates, so host math in double precision beats a device round trip.
+
+Where the reference emits one struct-typed output column, the columnar
+DataFrame here emits one flat column per measure (same names).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.param import (Param, identity, to_bool, to_float,
+                                     to_list, to_str)
+from mmlspark_tpu.core.pipeline import Transformer
+
+ASSOCIATION_METRICS = ("dp", "sdc", "ji", "llr", "pmi", "n_pmi_y",
+                       "n_pmi_xy", "s_pmi", "krc", "t_test")
+DISTRIBUTION_METRICS = ("kl_divergence", "js_dist", "inf_norm_dist",
+                        "total_variation_dist", "wasserstein_dist",
+                        "chi_sq_stat", "chi_sq_p_value")
+AGGREGATE_METRICS = ("atkinson_index", "theil_l_index", "theil_t_index")
+
+
+class _DataBalanceParams(Transformer):
+    """Shared params (exploratory/DataBalanceParams.scala:10-45)."""
+
+    sensitiveCols = Param("sensitiveCols", "sensitive columns to use",
+                          to_list(to_str))
+    outputCol = Param("outputCol", "output column", to_str)
+    verbose = Param("verbose", "include intermediate measures", to_bool,
+                    default=False)
+
+    def _sensitive_values(self, dataset: DataFrame, col: str) -> np.ndarray:
+        arr = dataset.col(col)
+        if arr.ndim != 1:
+            raise ValueError(f"sensitive column {col!r} must be scalar")
+        if not (arr.dtype == object or np.issubdtype(arr.dtype, np.integer)):
+            raise TypeError(
+                f"the sensitive column {col!r} does not contain integral "
+                f"or string values")
+        return arr
+
+
+def _association_metrics(p_pos: float, p_feature, p_pos_feature):
+    """Per-feature-value association metrics vs the positive label.
+
+    Vectorized over feature values; semantics match
+    FeatureBalanceMeasure.scala:203-266 including the log(0) = -inf /
+    guarded-normalization edge cases.
+    """
+    pf = np.asarray(p_feature, np.float64)
+    pxy = np.asarray(p_pos_feature, np.float64)
+    py = np.float64(p_pos)
+
+    dp = pxy / pf
+    sdc = pxy / (pf + py)
+    ji = pxy / (pf + py - pxy)
+    with np.errstate(divide="ignore"):
+        llr = np.log(pxy / py)
+        pmi = np.where(dp == 0.0, -np.inf, np.log(np.where(dp == 0, 1.0, dp)))
+        n_pmi_y = np.where(py == 0.0, 0.0, pmi / np.log(py))
+        n_pmi_xy = np.where(pxy == 0.0, 0.0,
+                            pmi / np.log(np.where(pxy == 0, 1.0, pxy)))
+        s_pmi = np.where(pf * py == 0.0, 0.0,
+                         np.where(pxy == 0.0, -np.inf,
+                                  np.log(np.where(pxy == 0, 1.0, pxy) ** 2
+                                         / (pf * py))))
+    return {"dp": dp, "sdc": sdc, "ji": ji, "llr": llr, "pmi": pmi,
+            "n_pmi_y": n_pmi_y, "n_pmi_xy": n_pmi_xy, "s_pmi": s_pmi}
+
+
+class FeatureBalanceMeasure(_DataBalanceParams):
+    """Association-measure gaps between each pair of values of each
+    sensitive feature, vs a binarized label.
+
+    Output: one row per (feature, classA, classB) with classA > classB,
+    and one column per measure holding the gap (A minus B; exactly 0
+    when both sides are equal, reproducing the reference's NaN guard,
+    FeatureBalanceMeasure.scala:142-146).
+    """
+
+    labelCol = Param("labelCol", "label column", to_str, default="label")
+    featureNameCol = Param("featureNameCol", "output column for feature names",
+                           to_str, default="FeatureName")
+    classACol = Param("classACol", "first compared feature value", to_str,
+                      default="ClassA")
+    classBCol = Param("classBCol", "second compared feature value", to_str,
+                      default="ClassB")
+
+    def __init__(self, **kwargs: Any):
+        kwargs.setdefault("outputCol", "FeatureBalanceMeasure")
+        super().__init__(**kwargs)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        label = np.asarray(dataset.col(self.get("labelCol")))
+        if not np.issubdtype(label.dtype, np.number):
+            raise TypeError(f"the label column named {self.get('labelCol')} "
+                            f"does not contain numeric values")
+        # binarize via int truncation then > 0 — the reference casts to
+        # LongType first (FeatureBalanceMeasure.scala:96), so 0.5 -> 0
+        y = (label.astype(np.int64) > 0).astype(np.float64)
+        n = float(len(y))
+        num_pos = float(y.sum())
+        p_pos = num_pos / n
+
+        out: Dict[str, List[Any]] = {
+            self.get("featureNameCol"): [], self.get("classACol"): [],
+            self.get("classBCol"): []}
+        for m in ASSOCIATION_METRICS:
+            out[m] = []
+        if self.get("verbose"):
+            out["prA"], out["prB"] = [], []
+
+        for col in self.get("sensitiveCols"):
+            self._sensitive_values(dataset, col)
+            groups = dataset.group_indices(col)
+            values = sorted(groups.keys(), key=str)
+            counts = np.array([len(groups[v]) for v in values], np.float64)
+            pos = np.array([y[groups[v]].sum() for v in values], np.float64)
+            metrics = _association_metrics(p_pos, counts / n, pos / n)
+            krc, ttest = _krc_ttest(n, p_pos, counts / n, pos / n)
+            metrics = {**metrics, "krc": krc, "t_test": ttest}
+            metrics = {k: np.asarray(v, np.float64) for k, v in metrics.items()}
+            dp_vals = metrics["dp"]
+            # all ordered pairs with str(A) > str(B)
+            for i, va in enumerate(values):
+                for j, vb in enumerate(values):
+                    if str(va) <= str(vb):
+                        continue
+                    out[self.get("featureNameCol")].append(col)
+                    out[self.get("classACol")].append(str(va))
+                    out[self.get("classBCol")].append(str(vb))
+                    for m in ASSOCIATION_METRICS:
+                        a, b = float(metrics[m][i]), float(metrics[m][j])
+                        out[m].append(0.0 if a == b else a - b)
+                    if self.get("verbose"):
+                        out["prA"].append(float(dp_vals[i]))
+                        out["prB"].append(float(dp_vals[j]))
+        return DataFrame({k: (np.asarray(v, dtype=object)
+                              if k in (self.get("featureNameCol"),
+                                       self.get("classACol"),
+                                       self.get("classBCol"))
+                              else np.asarray(v, np.float64))
+                          for k, v in out.items()})
+
+
+def _krc_ttest(n: float, p_pos: float, p_feature, p_pos_feature):
+    """Kendall rank correlation + t-test statistic per feature value
+    (FeatureBalanceMeasure.scala:255-265)."""
+    pf = np.asarray(p_feature, np.float64)
+    pxy = np.asarray(p_pos_feature, np.float64)
+    py = np.float64(p_pos)
+    a = n ** 2 * (1 - 2 * pf - 2 * py + 2 * pxy + 2 * pf * py)
+    b = n * (2 * pf + 2 * py - 4 * pxy - 1)
+    c = n ** 2 * np.sqrt((pf - pf ** 2) * (py - py ** 2))
+    krc = (a + b) / c
+    t_test = (pxy - pf * py) / np.sqrt(pf * py)
+    return krc, t_test
+
+
+def _rel_entropy(dist_a, dist_b) -> Any:
+    """sum of rel_entr(a, b) with scipy's case analysis
+    (DistributionBalanceMeasure.scala:277-287)."""
+    a = np.asarray(dist_a, np.float64)
+    b = np.asarray(dist_b, np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        terms = np.where(
+            a == 0.0, np.where(b >= 0.0, 0.0, np.inf),
+            np.where((a > 0.0) & (b > 0.0),
+                     a * np.log(np.where(a > 0, a, 1.0)
+                                / np.where(b > 0, b, 1.0)), np.inf))
+    return np.sum(terms)
+
+
+class DistributionBalanceMeasure(_DataBalanceParams):
+    """Distance measures between each sensitive feature's observed value
+    distribution and a reference distribution (uniform by default, or a
+    per-column custom map via ``referenceDistribution``).
+
+    Output: one row per sensitive feature; one column per measure.
+    """
+
+    featureNameCol = Param("featureNameCol", "output column for feature names",
+                           to_str, default="FeatureName")
+    referenceDistribution = Param(
+        "referenceDistribution",
+        "ordered list of reference distributions (dict per sensitive col; "
+        "empty dict = uniform)", identity, default=None)
+
+    def __init__(self, **kwargs: Any):
+        kwargs.setdefault("outputCol", "DistributionBalanceMeasure")
+        super().__init__(**kwargs)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        from scipy.stats import chi2
+
+        cols = self.get("sensitiveCols")
+        ref_dists = self.get("referenceDistribution")
+        if ref_dists is not None and len(ref_dists) != len(cols):
+            raise ValueError(
+                "The reference distribution must have the same length and "
+                "order as the sensitive columns: " + ", ".join(cols))
+        n = float(dataset.num_rows)
+        out: Dict[str, List[Any]] = {self.get("featureNameCol"): []}
+        for m in DISTRIBUTION_METRICS:
+            out[m] = []
+
+        for ci, col in enumerate(cols):
+            self._sensitive_values(dataset, col)
+            groups = dataset.group_indices(col)
+            values = sorted(groups.keys(), key=str)
+            k = len(values)
+            obs_count = np.asarray(
+                [len(groups[v]) for v in values], np.float64)
+            obs_prob = obs_count / n
+            custom = (ref_dists[ci] if ref_dists is not None
+                      and len(ref_dists[ci]) else None)
+            if custom is None:
+                ref_prob = np.full((k,), 1.0 / k, np.float64)
+            else:
+                # values absent from the custom dist get probability 0
+                ref_prob = np.asarray(
+                    [float(custom.get(str(v), custom.get(v, 0.0)))
+                     for v in values], np.float64)
+            ref_count = ref_prob * n
+
+            abs_diff = np.abs(obs_prob - ref_prob)
+            kl = _rel_entropy(obs_prob, ref_prob)
+            avg = (obs_prob + ref_prob) / 2.0
+            js = np.sqrt((_rel_entropy(ref_prob, avg)
+                          + _rel_entropy(obs_prob, avg)) / 2.0)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                chi_terms = np.where(
+                    (ref_count == 0) & (obs_count != 0), np.inf,
+                    (obs_count - ref_count) ** 2
+                    / np.where(ref_count == 0, 1.0, ref_count))
+            chi_sq = float(np.sum(chi_terms))
+            # left-tailed p-value; the reference maps an infinite statistic
+            # to 1.0 (DistributionBalanceMeasure.scala:268-272) — kept
+            # bug-compatible for parity
+            dof = max(k - 1, 1)
+            p_val = 1.0 if np.isinf(chi_sq) else float(
+                1.0 - chi2.cdf(chi_sq, df=dof))
+
+            out[self.get("featureNameCol")].append(col)
+            out["kl_divergence"].append(float(kl))
+            out["js_dist"].append(float(js))
+            out["inf_norm_dist"].append(float(np.max(abs_diff)))
+            out["total_variation_dist"].append(float(np.sum(abs_diff) * 0.5))
+            out["wasserstein_dist"].append(float(np.mean(abs_diff)))
+            out["chi_sq_stat"].append(float(chi_sq))
+            out["chi_sq_p_value"].append(float(p_val))
+        return DataFrame({k: (np.asarray(v, dtype=object)
+                              if k == self.get("featureNameCol")
+                              else np.asarray(v, np.float64))
+                          for k, v in out.items()})
+
+
+class AggregateBalanceMeasure(_DataBalanceParams):
+    """Single-row inequality indices over the joint distribution of all
+    sensitive features (AggregateBalanceMeasure.scala:93-106)."""
+
+    epsilon = Param("epsilon", "epsilon for Atkinson index (1 - alpha)",
+                    to_float, default=1.0)
+    errorTolerance = Param("errorTolerance",
+                           "error tolerance for Atkinson index", to_float,
+                           default=1e-12)
+
+    def __init__(self, **kwargs: Any):
+        kwargs.setdefault("outputCol", "AggregateBalanceMeasure")
+        super().__init__(**kwargs)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        cols = self.get("sensitiveCols")
+        for col in cols:
+            self._sensitive_values(dataset, col)
+        # joint groups over all sensitive columns (vectorized: per-column
+        # inverse codes combined into one joint code, then bincount)
+        codes = np.zeros(dataset.num_rows, dtype=np.int64)
+        for c in cols:
+            _, inv = np.unique(dataset.col(c).astype(str),
+                               return_inverse=True)
+            codes = codes * (inv.max() + 1) + inv
+        counts = np.bincount(
+            np.unique(codes, return_inverse=True)[1]).astype(np.float64)
+        probs = counts / float(dataset.num_rows)
+        num = float(len(counts))
+        norm = probs / np.mean(probs)
+
+        eps = self.get("epsilon")
+        tol = self.get("errorTolerance")
+        alpha = 1.0 - eps
+        if abs(alpha) < tol:
+            atkinson = 1.0 - float(
+                np.exp(np.sum(np.log(norm))) ** (1.0 / num))
+        else:
+            power_mean = float(np.sum(norm ** alpha)) / num
+            atkinson = 1.0 - power_mean ** (1.0 / alpha)
+        theil_l = float(np.sum(-np.log(norm))) / num
+        theil_t = float(np.sum(norm * np.log(norm))) / num
+        return DataFrame({
+            "atkinson_index": np.asarray([atkinson]),
+            "theil_l_index": np.asarray([theil_l]),
+            "theil_t_index": np.asarray([theil_t]),
+        })

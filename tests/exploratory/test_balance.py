@@ -1,0 +1,186 @@
+"""Balance-measure parity on the reference suite's worked example.
+
+The 9-row Gender/Ethnicity dataset and the independent metric
+calculators mirror the reference's test base
+(core/src/test/scala/.../exploratory/DataBalanceTestBase.scala:31-149);
+expected values are recomputed here in plain numpy/scipy-free Python so
+the module under test is checked against independent math.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.exploratory import (AggregateBalanceMeasure,
+                                      DistributionBalanceMeasure,
+                                      FeatureBalanceMeasure)
+
+
+@pytest.fixture()
+def sensitive_df():
+    rows = [
+        (0, "Male", "Asian"),
+        (0, "Male", "White"),
+        (1, "Male", "Other"),
+        (1, "Male", "Black"),
+        (0, "Female", "White"),
+        (0, "Female", "Black"),
+        (1, "Female", "Black"),
+        (0, "Other", "Asian"),
+        (0, "Other", "White"),
+    ]
+    return DataFrame({
+        "Label": np.array([r[0] for r in rows]),
+        "Gender": np.array([r[1] for r in rows], dtype=object),
+        "Ethnicity": np.array([r[2] for r in rows], dtype=object),
+    })
+
+
+def _assoc_gap(num_rows, p_y, p_x1, p_x1y, p_x2, p_x2y):
+    """DataBalanceTestBase.scala:50-81 AssociationMetricsCalculator."""
+    p_y_given_x1 = p_x1y / p_x1
+    p_y_given_x2 = p_x2y / p_x2
+    krc = []
+    for pf, pxy in ((p_x1, p_x1y), (p_x2, p_x2y)):
+        a = num_rows ** 2 * (1 - 2 * pf - 2 * p_y + 2 * pxy + 2 * pf * p_y)
+        b = num_rows * (2 * pf + 2 * p_y - 4 * pxy - 1)
+        c = num_rows ** 2 * math.sqrt((pf - pf ** 2) * (p_y - p_y ** 2))
+        krc.append((a + b) / c)
+    return {
+        "dp": p_y_given_x1 - p_y_given_x2,
+        "sdc": p_x1y / (p_x1 + p_y) - p_x2y / (p_x2 + p_y),
+        "ji": (p_x1y / (p_x1 + p_y - p_x1y)
+               - p_x2y / (p_x2 + p_y - p_x2y)),
+        "llr": math.log(p_x1y / p_y) - math.log(p_x2y / p_y),
+        "pmi": math.log(p_y_given_x1) - math.log(p_y_given_x2),
+        "n_pmi_y": (math.log(p_y_given_x1) / math.log(p_y)
+                    - math.log(p_y_given_x2) / math.log(p_y)),
+        "n_pmi_xy": (math.log(p_y_given_x1) / math.log(p_x1y)
+                     - math.log(p_y_given_x2) / math.log(p_x2y)),
+        "s_pmi": (math.log(p_x1y ** 2 / (p_x1 * p_y))
+                  - math.log(p_x2y ** 2 / (p_x2 * p_y))),
+        "krc": krc[0] - krc[1],
+        "t_test": ((p_x1y - p_x1 * p_y) / math.sqrt(p_x1 * p_y)
+                   - (p_x2y - p_x2 * p_y) / math.sqrt(p_x2 * p_y)),
+    }
+
+
+def test_feature_balance_gender_male_vs_female(sensitive_df):
+    out = FeatureBalanceMeasure(
+        sensitiveCols=["Gender"], labelCol="Label").transform(sensitive_df)
+    rows = {(out["ClassA"][i], out["ClassB"][i]): i
+            for i in range(out.num_rows)}
+    assert set(rows) == {("Male", "Female"), ("Other", "Male"),
+                         ("Other", "Female")}
+    # 9 rows, 3 positive; Male: 4 rows 2 pos; Female: 3 rows 1 pos
+    want = _assoc_gap(9.0, 3 / 9, 4 / 9, 2 / 9, 3 / 9, 1 / 9)
+    i = rows[("Male", "Female")]
+    for m, v in want.items():
+        assert out[m][i] == pytest.approx(v, abs=1e-8), m
+
+
+def test_feature_balance_pair_count_and_verbose(sensitive_df):
+    out = FeatureBalanceMeasure(
+        sensitiveCols=["Gender", "Ethnicity"], labelCol="Label",
+        verbose=True).transform(sensitive_df)
+    # C(3,2) gender pairs + C(4,2) ethnicity pairs
+    assert out.num_rows == 3 + 6
+    assert "prA" in out.columns and "prB" in out.columns
+    eth = out.filter(out["FeatureName"] == "Ethnicity")
+    assert eth.num_rows == 6
+
+
+def test_distribution_balance_uniform(sensitive_df):
+    out = DistributionBalanceMeasure(
+        sensitiveCols=["Gender", "Ethnicity"]).transform(sensitive_df)
+    assert out.num_rows == 2
+    gi = list(out["FeatureName"]).index("Gender")
+    # Gender: Male 4/9, Female 3/9, Other 2/9 vs uniform 1/3
+    obs = np.array([3 / 9, 4 / 9, 2 / 9])  # sorted: Female, Male, Other
+    ref = np.full(3, 1 / 3)
+    kl = float(np.sum(obs * np.log(obs / ref)))
+    assert out["kl_divergence"][gi] == pytest.approx(kl, abs=1e-8)
+    avg = (obs + ref) / 2
+    js = math.sqrt((np.sum(ref * np.log(ref / avg))
+                    + np.sum(obs * np.log(obs / avg))) / 2)
+    assert out["js_dist"][gi] == pytest.approx(js, abs=1e-8)
+    diff = np.abs(obs - ref)
+    assert out["inf_norm_dist"][gi] == pytest.approx(diff.max(), abs=1e-8)
+    assert out["total_variation_dist"][gi] == pytest.approx(
+        diff.sum() / 2, abs=1e-8)
+    assert out["wasserstein_dist"][gi] == pytest.approx(
+        diff.mean(), abs=1e-8)
+    chi = float(np.sum((obs * 9 - ref * 9) ** 2 / (ref * 9)))
+    assert out["chi_sq_stat"][gi] == pytest.approx(chi, abs=1e-8)
+    from scipy.stats import chi2
+    assert out["chi_sq_p_value"][gi] == pytest.approx(
+        1 - chi2.cdf(chi, df=2), abs=1e-6)
+
+
+def test_distribution_balance_custom_reference(sensitive_df):
+    ref = {"Male": 0.5, "Female": 0.3, "Other": 0.2}
+    out = DistributionBalanceMeasure(
+        sensitiveCols=["Gender"],
+        referenceDistribution=[ref]).transform(sensitive_df)
+    obs = {"Female": 3 / 9, "Male": 4 / 9, "Other": 2 / 9}
+    diff = [abs(obs[v] - ref[v]) for v in ("Female", "Male", "Other")]
+    assert out["inf_norm_dist"][0] == pytest.approx(max(diff), abs=1e-8)
+    # mismatched length must raise
+    with pytest.raises(ValueError):
+        DistributionBalanceMeasure(
+            sensitiveCols=["Gender", "Ethnicity"],
+            referenceDistribution=[ref]).transform(sensitive_df)
+
+
+def test_aggregate_balance_measures(sensitive_df):
+    out = AggregateBalanceMeasure(
+        sensitiveCols=["Gender"]).transform(sensitive_df)
+    probs = np.array([4 / 9, 3 / 9, 2 / 9])
+    norm = probs / probs.mean()
+    # epsilon=1 -> alpha=0 -> geometric-mean branch
+    atkinson = 1 - float(np.prod(norm)) ** (1 / 3)
+    theil_l = float(np.sum(-np.log(norm))) / 3
+    theil_t = float(np.sum(norm * np.log(norm))) / 3
+    assert out["atkinson_index"][0] == pytest.approx(atkinson, abs=1e-8)
+    assert out["theil_l_index"][0] == pytest.approx(theil_l, abs=1e-8)
+    assert out["theil_t_index"][0] == pytest.approx(theil_t, abs=1e-8)
+    # joint grouping over two sensitive cols
+    out2 = AggregateBalanceMeasure(
+        sensitiveCols=["Gender", "Ethnicity"],
+        epsilon=0.5).transform(sensitive_df)
+    # 8 distinct (gender, ethnicity) combos of 9 rows; F-Black has 2
+    counts = np.array([1, 1, 1, 1, 1, 2, 1, 1], np.float64)
+    probs = counts / 9.0
+    norm = probs / probs.mean()
+    power_mean = float(np.sum(norm ** 0.5)) / 8
+    assert out2["atkinson_index"][0] == pytest.approx(
+        1 - power_mean ** 2, abs=1e-8)
+
+
+def test_feature_balance_zero_positive_group():
+    # a group with no positive labels: pmi/llr/s_pmi hit log(0) = -inf
+    # on the A side, so the gap is -inf (reference keeps the -inf)
+    df = DataFrame({
+        "Label": np.array([0, 0, 1, 1]),
+        "g": np.array(["a", "a", "b", "b"], dtype=object),
+    })
+    out = FeatureBalanceMeasure(sensitiveCols=["g"],
+                                labelCol="Label").transform(df)
+    i = {(out["ClassA"][k], out["ClassB"][k]): k
+         for k in range(out.num_rows)}[("b", "a")]
+    # A=b has all positives, B=a has none: gap = finite - (-inf) = +inf
+    assert out["pmi"][i] == math.inf
+    assert out["s_pmi"][i] == math.inf
+    assert out["llr"][i] == math.inf
+
+
+def test_feature_balance_rejects_bad_columns(sensitive_df):
+    df = sensitive_df.with_column("fval", np.ones(9))
+    with pytest.raises(TypeError):
+        FeatureBalanceMeasure(sensitiveCols=["fval"],
+                              labelCol="Label").transform(df)
+    with pytest.raises(TypeError):
+        FeatureBalanceMeasure(sensitiveCols=["Gender"],
+                              labelCol="Gender").transform(sensitive_df)

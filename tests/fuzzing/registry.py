@@ -106,6 +106,9 @@ def build_registry() -> Dict[str, TestObject]:
     from mmlspark_tpu.dl.text import DeepTextClassifier
     from mmlspark_tpu.dl.vision import DeepVisionClassifier
     from mmlspark_tpu.dl.embedder import SentenceEmbedder
+    from mmlspark_tpu.exploratory.balance import (AggregateBalanceMeasure,
+                                                  DistributionBalanceMeasure,
+                                                  FeatureBalanceMeasure)
     from mmlspark_tpu.explainers.ice import ICETransformer
     from mmlspark_tpu.explainers.lime import (TabularLIME, TextLIME,
                                               VectorLIME)
@@ -255,6 +258,14 @@ def build_registry() -> Dict[str, TestObject]:
                 tab.select("x1", "label"))),
         "PartitionConsolidator": TestObject(PartitionConsolidator(), tab),
         "SummarizeData": TestObject(SummarizeData(), tab.select("x1", "x2")),
+        # exploratory (balance measures)
+        "FeatureBalanceMeasure": TestObject(
+            FeatureBalanceMeasure(sensitiveCols=["cat"], labelCol="label"),
+            tab),
+        "DistributionBalanceMeasure": TestObject(
+            DistributionBalanceMeasure(sensitiveCols=["cat"]), tab),
+        "AggregateBalanceMeasure": TestObject(
+            AggregateBalanceMeasure(sensitiveCols=["cat"]), tab),
         "TextPreprocessor": TestObject(
             TextPreprocessor(inputCol="text", outputCol="clean",
                              map={"good": "great"}), tab),
