@@ -170,17 +170,12 @@ def _krc_ttest(n: float, p_pos: float, p_feature, p_pos_feature):
 
 
 def _rel_entropy(dist_a, dist_b) -> Any:
-    """sum of rel_entr(a, b) with scipy's case analysis
-    (DistributionBalanceMeasure.scala:277-287)."""
-    a = np.asarray(dist_a, np.float64)
-    b = np.asarray(dist_b, np.float64)
-    with np.errstate(divide="ignore", invalid="ignore"):
-        terms = np.where(
-            a == 0.0, np.where(b >= 0.0, 0.0, np.inf),
-            np.where((a > 0.0) & (b > 0.0),
-                     a * np.log(np.where(a > 0, a, 1.0)
-                                / np.where(b > 0, b, 1.0)), np.inf))
-    return np.sum(terms)
+    """sum of rel_entr(a, b) — the exact case analysis the reference
+    replicates (DistributionBalanceMeasure.scala:277-287) is scipy's."""
+    from scipy.special import rel_entr
+
+    return float(np.sum(rel_entr(np.asarray(dist_a, np.float64),
+                                 np.asarray(dist_b, np.float64))))
 
 
 class DistributionBalanceMeasure(_DataBalanceParams):
@@ -302,8 +297,9 @@ class AggregateBalanceMeasure(_DataBalanceParams):
         tol = self.get("errorTolerance")
         alpha = 1.0 - eps
         if abs(alpha) < tol:
-            atkinson = 1.0 - float(
-                np.exp(np.sum(np.log(norm))) ** (1.0 / num))
+            # geometric mean in log space (exp(sum) underflows for many
+            # groups; exp(mean) cannot)
+            atkinson = 1.0 - float(np.exp(np.sum(np.log(norm)) / num))
         else:
             power_mean = float(np.sum(norm ** alpha)) / num
             atkinson = 1.0 - power_mean ** (1.0 / alpha)

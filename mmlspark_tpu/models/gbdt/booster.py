@@ -48,10 +48,20 @@ class BoosterArrays:
     objective: str = "regression"
     init_score: float = 0.0
     feature_names: Optional[List[str]] = None
+    # categorical splits: decision_type bit 0 set marks a node that
+    # routes by set membership; cat_bitset (T, M, W) uint32 packs the
+    # left-set over raw category values (LightGBM cat_threshold layout)
+    decision_type: Optional[np.ndarray] = None   # (T, M) int8
+    cat_bitset: Optional[np.ndarray] = None      # (T, M, W) uint32
 
     @property
     def num_trees(self) -> int:
         return self.split_feature.shape[0]
+
+    @property
+    def has_categorical(self) -> bool:
+        return (self.decision_type is not None and self.cat_bitset is not None
+                and bool((self.decision_type & 1).any()))
 
     def _jitted(self, name: str, maker):
         """Per-instance cache of jitted scorers — transform is called in
@@ -76,6 +86,37 @@ class BoosterArrays:
     def num_nodes(self) -> int:
         return self.split_feature.shape[1]
 
+    def _go_left_fn(self):
+        """Shared per-step routing: (tree_idx, node, fx) -> bool (N,).
+
+        Numerical nodes: NaN or value <= threshold goes left. Categorical
+        nodes (decision_type bit 0): integral value whose bit is set in
+        the node's value bitset goes left; NaN / non-integral / unseen
+        values go right (LightGBM's unseen-category rule)."""
+        import jax.numpy as jnp
+
+        tv = jnp.asarray(self.threshold_value)
+        if not self.has_categorical:
+            def go_left(tree_idx, node, fx):
+                return jnp.isnan(fx) | (fx <= tv[tree_idx][node])
+            return go_left
+
+        dt = jnp.asarray(self.decision_type)
+        bs = jnp.asarray(self.cat_bitset)
+        w = int(self.cat_bitset.shape[2])
+
+        def go_left(tree_idx, node, fx):
+            is_cat = (dt[tree_idx][node] & 1) == 1
+            num_left = jnp.isnan(fx) | (fx <= tv[tree_idx][node])
+            safe = jnp.where(jnp.isnan(fx), -1.0, fx)
+            valid = (safe >= 0) & (safe < w * 32) & (safe == jnp.floor(safe))
+            vi = jnp.clip(safe, 0, w * 32 - 1).astype(jnp.int32)
+            word = bs[tree_idx][node, vi >> 5]
+            member = ((word >> (vi & 31).astype(jnp.uint32)) & 1) == 1
+            return jnp.where(is_cat, valid & member, num_left)
+
+        return go_left
+
     # -- device-side batch prediction ---------------------------------------
     def predict_fn(self):
         """Returns jittable fn: raw features (N, F) -> raw scores.
@@ -87,10 +128,10 @@ class BoosterArrays:
         import jax.numpy as jnp
 
         sf = jnp.asarray(self.split_feature)
-        tv = jnp.asarray(self.threshold_value)
         nv = jnp.asarray(self.node_value)
         tw = jnp.asarray(self.tree_weights)
         depth, k = self.max_depth, self.num_class
+        route = self._go_left_fn()
 
         def one_tree(carry, tree_idx):
             acc, x = carry
@@ -100,7 +141,7 @@ class BoosterArrays:
                 is_leaf = feat < 0
                 fx = jnp.take_along_axis(
                     x, jnp.maximum(feat, 0)[:, None], axis=1)[:, 0]
-                go_left = jnp.isnan(fx) | (fx <= tv[tree_idx][node])
+                go_left = route(tree_idx, node, fx)
                 child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
                 node = jnp.where(is_leaf, node, child)
             val = nv[tree_idx][node] * tw[tree_idx]
@@ -124,8 +165,8 @@ class BoosterArrays:
         import jax.numpy as jnp
 
         sf = jnp.asarray(self.split_feature)
-        tv = jnp.asarray(self.threshold_value)
         depth = self.max_depth
+        route = self._go_left_fn()
 
         def leaves(x):
             x = jnp.asarray(x)
@@ -137,7 +178,7 @@ class BoosterArrays:
                     is_leaf = feat < 0
                     fx = jnp.take_along_axis(
                         x_c, jnp.maximum(feat, 0)[:, None], axis=1)[:, 0]
-                    go_left = jnp.isnan(fx) | (fx <= tv[tree_idx][node])
+                    go_left = route(tree_idx, node, fx)
                     child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
                     node = jnp.where(is_leaf, node, child)
                 return x_c, node
@@ -161,10 +202,10 @@ class BoosterArrays:
         import jax.numpy as jnp
 
         sf = jnp.asarray(self.split_feature)
-        tv = jnp.asarray(self.threshold_value)
         nv = jnp.asarray(self.node_value)
         tw = jnp.asarray(self.tree_weights)
         depth, num_f, k = self.max_depth, self.num_features, self.num_class
+        route = self._go_left_fn()
 
         def contribs(x):
             x = jnp.asarray(x)
@@ -179,7 +220,7 @@ class BoosterArrays:
                     is_leaf = feat < 0
                     fx = jnp.take_along_axis(
                         x, jnp.maximum(feat, 0)[:, None], axis=1)[:, 0]
-                    go_left = jnp.isnan(fx) | (fx <= tv[tree_idx][node])
+                    go_left = route(tree_idx, node, fx)
                     child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
                     child = jnp.where(is_leaf, node, child)
                     delta = (nv[tree_idx][child] - nv[tree_idx][node]) * tw[tree_idx]
@@ -253,6 +294,8 @@ class BoosterArrays:
         sf, tb, tv, nv, cnt = (self.split_feature[t], self.threshold_bin[t],
                                self.threshold_value[t], self.node_value[t],
                                self.count[t])
+        dt = (self.decision_type[t] if self.decision_type is not None
+              else np.zeros_like(sf, dtype=np.int8))
         # map full-layout slots to LightGBM internal/leaf numbering (BFS)
         internal_ids: Dict[int, int] = {}
         leaf_ids: Dict[int, int] = {}
@@ -272,10 +315,23 @@ class BoosterArrays:
             return internal_ids[m] if sf[m] >= 0 else ~leaf_ids[m]
 
         split_feature, threshold, left, right = [], [], [], []
-        internal_value, internal_count = [], []
+        internal_value, internal_count, decision = [], [], []
+        cat_boundaries: List[int] = [0]
+        cat_words: List[int] = []
         for m in order:
             split_feature.append(int(sf[m]))
-            threshold.append(float(tv[m]))
+            is_cat = bool(dt[m] & 1)
+            if is_cat:
+                # categorical: threshold stores the index into
+                # cat_boundaries/cat_threshold (LightGBM layout)
+                words = [int(w) for w in self.cat_bitset[t, m]]
+                threshold.append(float(len(cat_boundaries) - 1))
+                cat_words.extend(words)
+                cat_boundaries.append(len(cat_words))
+                decision.append(1)
+            else:
+                threshold.append(float(tv[m]))
+                decision.append(2)  # default-left: our NaN routes left
             left.append(child_code(2 * m + 1))
             right.append(child_code(2 * m + 2))
             internal_value.append(float(nv[m]))
@@ -283,14 +339,15 @@ class BoosterArrays:
         leaves = sorted(leaf_ids, key=lambda m: leaf_ids[m])
         leaf_value = [float(nv[m] * self.tree_weights[t]) for m in leaves]
         leaf_count = [int(cnt[m]) for m in leaves]
+        num_cat = len(cat_boundaries) - 1
         out = [
             f"Tree={t}",
             f"num_leaves={max(len(leaves), 1)}",
-            "num_cat=0",
+            f"num_cat={num_cat}",
             "split_feature=" + " ".join(map(str, split_feature)),
             "split_gain=" + " ".join("0" for _ in range(n_int)),
             "threshold=" + " ".join(repr(v) for v in threshold),
-            "decision_type=" + " ".join("2" for _ in range(n_int)),
+            "decision_type=" + " ".join(map(str, decision)),
             "left_child=" + " ".join(map(str, left)),
             "right_child=" + " ".join(map(str, right)),
             "leaf_value=" + " ".join(repr(v) for v in leaf_value),
@@ -302,6 +359,11 @@ class BoosterArrays:
             "is_linear=0",
             "shrinkage=1",
         ]
+        if num_cat:
+            out.insert(out.index("is_linear=0"),
+                       "cat_boundaries=" + " ".join(map(str, cat_boundaries)))
+            out.insert(out.index("is_linear=0"),
+                       "cat_threshold=" + " ".join(map(str, cat_words)))
         return out
 
     @staticmethod
@@ -354,6 +416,17 @@ class BoosterArrays:
         if "tree_weights" in header:
             weights = np.asarray(list(map(float, header["tree_weights"].split())),
                                  dtype=np.float32)
+        # size the runtime bitset: widest cat node across all trees
+        max_words = 0
+        for blk in tree_blocks:
+            if int(blk.get("num_cat", "0")) > 0:
+                bounds = list(map(int, blk["cat_boundaries"].split()))
+                max_words = max(max_words,
+                                max(bounds[i + 1] - bounds[i]
+                                    for i in range(len(bounds) - 1)))
+        dt = np.zeros((n_trees, m_slots), np.int8) if max_words else None
+        bitset = (np.zeros((n_trees, m_slots, max_words), np.uint32)
+                  if max_words else None)
         for t, blk in enumerate(tree_blocks):
             n_leaves = int(blk.get("num_leaves", "1"))
             leaf_value = list(map(float, blk["leaf_value"].split()))
@@ -370,19 +443,35 @@ class BoosterArrays:
             right = list(map(int, blk["right_child"].split()))
             internal_value = list(map(float, blk["internal_value"].split()))
             internal_count = list(map(float, blk["internal_count"].split()))
+            decision = (list(map(int, blk["decision_type"].split()))
+                        if blk.get("decision_type") else [2] * len(split_feature))
+            cat_bounds = (list(map(int, blk["cat_boundaries"].split()))
+                          if int(blk.get("num_cat", "0")) > 0 else [])
+            cat_words = (list(map(int, blk["cat_threshold"].split()))
+                         if cat_bounds else [])
 
             def place(code: int, slot: int, t=t, split_feature=split_feature,
                       threshold=threshold, left=left, right=right,
                       internal_value=internal_value,
                       internal_count=internal_count,
-                      leaf_value=leaf_value, leaf_count=leaf_count):
+                      leaf_value=leaf_value, leaf_count=leaf_count,
+                      decision=decision, cat_bounds=cat_bounds,
+                      cat_words=cat_words):
                 if code < 0:
                     leaf = ~code
                     nv[t, slot] = leaf_value[leaf] / max(weights[t], 1e-30)
                     cnt[t, slot] = leaf_count[leaf] if leaf < len(leaf_count) else 0
                     return
                 sf[t, slot] = split_feature[code]
-                tv[t, slot] = threshold[code]
+                if decision[code] & 1:
+                    cat_idx = int(threshold[code])
+                    lo, hi = cat_bounds[cat_idx], cat_bounds[cat_idx + 1]
+                    dt[t, slot] = 1
+                    tv[t, slot] = np.nan
+                    bitset[t, slot, :hi - lo] = np.asarray(
+                        cat_words[lo:hi], dtype=np.int64).astype(np.uint32)
+                else:
+                    tv[t, slot] = threshold[code]
                 nv[t, slot] = internal_value[code]
                 cnt[t, slot] = internal_count[code]
                 place(left[code], 2 * slot + 1)
@@ -396,6 +485,7 @@ class BoosterArrays:
             objective=header.get("objective", "regression"),
             init_score=float(header.get("init_score", "0.0")),
             feature_names=header.get("feature_names", "").split() or None,
+            decision_type=dt, cat_bitset=bitset,
         )
 
     @staticmethod
@@ -416,6 +506,22 @@ class BoosterArrays:
             out[:, :x.shape[1]] = x
             return out
 
+        dt = bitset = None
+        if a.decision_type is not None or b.decision_type is not None:
+            dt_a = (a.decision_type if a.decision_type is not None
+                    else np.zeros_like(a.split_feature, dtype=np.int8))
+            dt_b = (b.decision_type if b.decision_type is not None
+                    else np.zeros_like(b.split_feature, dtype=np.int8))
+            dt = np.concatenate([pad(dt_a, 0), pad(dt_b, 0)])
+            w_a = a.cat_bitset.shape[2] if a.cat_bitset is not None else 1
+            w_b = b.cat_bitset.shape[2] if b.cat_bitset is not None else 1
+            words = max(w_a, w_b)
+            bitset = np.zeros((dt.shape[0], slots, words), np.uint32)
+            if a.cat_bitset is not None:
+                bitset[:a.num_trees, :a.num_nodes, :w_a] = a.cat_bitset
+            if b.cat_bitset is not None:
+                bitset[a.num_trees:, :b.num_nodes, :w_b] = b.cat_bitset
+
         return BoosterArrays(
             split_feature=np.concatenate([pad(a.split_feature, -1),
                                           pad(b.split_feature, -1)]),
@@ -433,6 +539,7 @@ class BoosterArrays:
             objective=b.objective,
             init_score=a.init_score,
             feature_names=a.feature_names or b.feature_names,
+            decision_type=dt, cat_bitset=bitset,
         )
 
     # -- generic state dict (for Model persistence) -------------------------
@@ -452,6 +559,9 @@ class BoosterArrays:
                 "init_score": self.init_score,
                 "feature_names": self.feature_names,
             },
+            **({"decision_type": self.decision_type,
+                "cat_bitset": self.cat_bitset}
+               if self.decision_type is not None else {}),
         }
 
     @staticmethod
@@ -470,4 +580,8 @@ class BoosterArrays:
             objective=meta["objective"],
             init_score=meta["init_score"],
             feature_names=meta.get("feature_names"),
+            decision_type=(np.asarray(state["decision_type"])
+                           if state.get("decision_type") is not None else None),
+            cat_bitset=(np.asarray(state["cat_bitset"]).astype(np.uint32)
+                        if state.get("cat_bitset") is not None else None),
         )

@@ -92,6 +92,17 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCo
     categoricalSlotIndexes = Param("categoricalSlotIndexes",
                                    "indices of categorical features",
                                    to_list(to_int))
+    catSmooth = Param("catSmooth", "categorical smoothing added to the "
+                      "per-bin hessian in the sort ratio", to_float, ge(0),
+                      default=10.0)
+    catL2 = Param("catL2", "extra L2 for categorical splits", to_float,
+                  ge(0), default=10.0)
+    maxCatThreshold = Param("maxCatThreshold", "max categories on the "
+                            "scanned side of a categorical split", to_int,
+                            gt(0), default=32)
+    maxCatToOnehot = Param("maxCatToOnehot", "use one-vs-rest splits when "
+                           "a node has at most this many used categories",
+                           to_int, gt(0), default=4)
     objective = Param("objective", "training objective", to_str)
     metric = Param("metric", "eval metric (default per objective)", to_str)
     modelString = Param("modelString", "warm-start model string", to_str)
@@ -146,6 +157,12 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCo
             sigmoid=sigmoid,
             early_stopping_round=self.get("earlyStoppingRound"),
             metric=self.get("metric"),
+            categorical_features=tuple(self.get("categoricalSlotIndexes")
+                                       or ()),
+            cat_smooth=self.get("catSmooth"),
+            cat_l2=self.get("catL2"),
+            max_cat_threshold=self.get("maxCatThreshold"),
+            max_cat_to_onehot=self.get("maxCatToOnehot"),
             tree_learner={"data_parallel": "data",
                           "voting_parallel": "voting",
                           "feature_parallel": "feature",

@@ -85,12 +85,23 @@ class TrainConfig:
     seed: int = 0
     deterministic: bool = True
     boost_from_average: bool = True
+    # categorical split handling (params/LightGBMParams.scala categorical
+    # group; core/schema/Categoricals.scala): features listed here split
+    # by set membership over category bins, not ordered thresholds
+    categorical_features: Any = ()
+    cat_smooth: float = 10.0      # added to hessian in the sort ratio
+    cat_l2: float = 10.0          # extra L2 when evaluating cat splits
+    max_cat_threshold: int = 32   # max categories on the scanned side
+    max_cat_to_onehot: int = 4    # <=: one-vs-rest instead of sorted scan
 
     def __post_init__(self):
         # eval_at may arrive as a list; the config is used as a cache key
         # for compiled functions, so every field must be hashable
         if isinstance(self.eval_at, list):
             object.__setattr__(self, "eval_at", tuple(self.eval_at))
+        if isinstance(self.categorical_features, (list, np.ndarray)):
+            object.__setattr__(self, "categorical_features",
+                               tuple(int(i) for i in self.categorical_features))
 
     @property
     def effective_depth(self) -> int:
@@ -126,10 +137,24 @@ def _objective_kwargs(cfg: TrainConfig) -> Dict[str, Any]:
 
 def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
     """Compile-once tree builder: (binned, grad, hess, valid, feat_mask,
-    remaining_leaves) -> (split_feature, threshold_bin, node_value, count).
+    remaining_leaves) -> (split_feature, threshold_bin, node_value, count,
+    decision_type, bin_go_left).
 
     All shapes static: N rows, F features, B bins, depth D. Returns the
-    full-layout arrays described in booster.py.
+    full-layout arrays described in booster.py; ``bin_go_left`` is a
+    (num_slots, B) bool mask — for every internal slot, which bin ids
+    route left. Numerical splits fill it with ``bin <= threshold``;
+    categorical splits with the chosen category subset, so row routing
+    and binned prediction are a single gather regardless of split type.
+
+    Categorical features (``cfg.categorical_features``) follow LightGBM's
+    algorithm (core/schema/Categoricals.scala; LightGBM's
+    FindBestThresholdCategorical): bins sorted by grad/(hess+cat_smooth),
+    prefix scan with ``lambda_l2 + cat_l2`` regularization and the
+    ``max_cat_threshold`` side cap; nodes with few used categories
+    (<= max_cat_to_onehot) use one-vs-rest splits instead. The missing
+    bin (0) is never placed in a categorical left set — missing routes
+    right, matching LightGBM's unseen-category rule.
     """
     import jax
     import jax.numpy as jnp
@@ -140,12 +165,18 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
     min_child = float(cfg.min_data_in_leaf)
     min_hess = cfg.min_sum_hessian_in_leaf
     min_gain = cfg.min_gain_to_split
+    cat_feats = tuple(cfg.categorical_features or ())
+    is_cat_np = np.zeros(num_features, dtype=bool)
+    if cat_feats:
+        is_cat_np[list(cat_feats)] = True
+    has_cat = bool(is_cat_np.any())
 
-    def leaf_objective(g, h):
+    def leaf_objective(g, h, extra_l2=0.0):
         # L1-regularized leaf value and its score contribution
         g_adj = jnp.sign(g) * jnp.maximum(jnp.abs(g) - lam1, 0.0)
-        value = -g_adj / (h + lam2 + 1e-30)
-        score = g_adj * g_adj / (h + lam2 + 1e-30)
+        denom = h + lam2 + extra_l2 + 1e-30
+        value = -g_adj / denom
+        score = g_adj * g_adj / denom
         return value, score
 
     def build_tree(binned, grad, hess, valid, feat_mask, remaining_leaves):
@@ -162,6 +193,9 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
         threshold_bin = jnp.zeros(num_slots, dtype=jnp.int32)
         node_value = jnp.zeros(num_slots, dtype=jnp.float32)
         node_count = jnp.zeros(num_slots, dtype=jnp.float32)
+        decision_type = jnp.zeros(num_slots, dtype=jnp.int8)
+        bin_go_left = jnp.zeros((num_slots, b), dtype=jnp.bool_)
+        is_cat_f = jnp.asarray(is_cat_np)
         # root stats
         root_g, root_h, root_c = (jnp.sum(grad * valid), jnp.sum(hess * valid),
                                   jnp.sum(valid))
@@ -189,7 +223,7 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
             hist = jax.ops.segment_sum(data, idx, num_segments=width * f * b)
             hist = hist.reshape(width, f, b, 3)
 
-            # --- split finding -----------------------------------------
+            # --- numerical split finding: ordered cumulative scan -------
             cum = jnp.cumsum(hist, axis=2)              # left stats per bin
             tot = cum[:, :, -1:, :]
             gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
@@ -206,6 +240,50 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
             # last bin can't split (right side empty by construction)
             ok &= jnp.arange(b)[None, None, :] < b - 1
             gain = jnp.where(ok, gain, -jnp.inf)
+
+            if has_cat:
+                # --- categorical split finding ----------------------
+                g_b, h_b, c_b = hist[..., 0], hist[..., 1], hist[..., 2]
+                not_missing = jnp.arange(b)[None, None, :] > 0
+                used = (c_b > 0) & not_missing
+                ratio = jnp.where(used, g_b / (h_b + cfg.cat_smooth),
+                                  jnp.inf)
+                sort_idx = jnp.argsort(ratio, axis=2)   # unused sort last
+                shist = jnp.take_along_axis(
+                    hist, sort_idx[..., None], axis=2)
+                scum = jnp.cumsum(shist, axis=2)
+                num_used = jnp.sum(used, axis=2)        # (width, F)
+                gl_c, hl_c, cl_c = scum[..., 0], scum[..., 1], scum[..., 2]
+                gr_c, hr_c = gt - gl_c, ht - hl_c
+                cr_c = ct - cl_c
+                _, cscore_l = leaf_objective(gl_c, hl_c, cfg.cat_l2)
+                _, cscore_r = leaf_objective(gr_c, hr_c, cfg.cat_l2)
+                _, cscore_p = leaf_objective(gt, ht, cfg.cat_l2)
+                cgain = 0.5 * (cscore_l + cscore_r - cscore_p)
+                pos1 = jnp.arange(1, b + 1)[None, None, :]  # left-set size
+                side = jnp.minimum(pos1, num_used[..., None] - pos1)
+                cok = ((pos1 < num_used[..., None])
+                       & (side <= cfg.max_cat_threshold)
+                       & (cl_c >= min_child) & (cr_c >= min_child)
+                       & (hl_c >= min_hess) & (hr_c >= min_hess)
+                       & (cgain > min_gain))
+                cgain = jnp.where(cok, cgain, -jnp.inf)
+                # one-vs-rest for low-cardinality nodes (indexed by the
+                # actual bin id, not a sort position)
+                gr_o, hr_o, cr_o = gt - g_b, ht - h_b, ct - c_b
+                _, oscore_l = leaf_objective(g_b, h_b, cfg.cat_l2)
+                _, oscore_r = leaf_objective(gr_o, hr_o, cfg.cat_l2)
+                ogain = 0.5 * (oscore_l + oscore_r - cscore_p)
+                ook = (used & (c_b >= min_child) & (cr_o >= min_child)
+                       & (h_b >= min_hess) & (hr_o >= min_hess)
+                       & (ogain > min_gain) & (num_used[..., None] > 1))
+                ogain = jnp.where(ook, ogain, -jnp.inf)
+                onehot = (num_used <= cfg.max_cat_to_onehot)[..., None]
+                cat_gain = jnp.where(onehot, ogain, cgain)
+                cat_gain = jnp.where(feat_mask[None, :, None] > 0,
+                                     cat_gain, -jnp.inf)
+                gain = jnp.where(is_cat_f[None, :, None], cat_gain, gain)
+
             flat_gain = gain.reshape(width, f * b)
             best_fb = jnp.argmax(flat_gain, axis=1)
             best_gain = jnp.take_along_axis(flat_gain, best_fb[:, None], 1)[:, 0]
@@ -221,22 +299,43 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
             remaining = remaining + 0 if width == 0 else (
                 remaining - jnp.sum(do_split.astype(jnp.int32)))
 
+            # --- per-node left-bin mask for the chosen split -------------
+            sel = jnp.arange(width)
+            mask_num = jnp.arange(b)[None, :] <= best_bin[:, None]
+            if has_cat:
+                chosen_cat = is_cat_f[best_feat] & do_split
+                s_idx = sort_idx[sel, best_feat]        # (width, B)
+                # rank of bin id in sorted order = inverse permutation
+                bin_rank = jnp.argsort(s_idx, axis=1)
+                used_sel = used[sel, best_feat]
+                onehot_sel = num_used[sel, best_feat] <= cfg.max_cat_to_onehot
+                mask_prefix = (bin_rank <= best_bin[:, None]) & used_sel
+                mask_onehot = jnp.arange(b)[None, :] == best_bin[:, None]
+                mask_cat = jnp.where(onehot_sel[:, None], mask_onehot,
+                                     mask_prefix)
+                left_mask = jnp.where(chosen_cat[:, None], mask_cat, mask_num)
+            else:
+                chosen_cat = jnp.zeros(width, dtype=jnp.bool_)
+                left_mask = mask_num
+
             # --- record splits & child stats -----------------------------
             slots = level_start + jnp.arange(width)
             split_feature = split_feature.at[slots].set(
                 jnp.where(do_split, best_feat, -1))
             threshold_bin = threshold_bin.at[slots].set(
                 jnp.where(do_split, best_bin, 0))
+            decision_type = decision_type.at[slots].set(
+                jnp.where(chosen_cat, 1, 0).astype(jnp.int8))
+            bin_go_left = bin_go_left.at[slots].set(
+                left_mask & do_split[:, None])
 
-            sel = jnp.arange(width)
             hist_best = hist[sel, best_feat]            # (width, B, 3)
-            cum_best = jnp.cumsum(hist_best, axis=1)
-            left_stats = jnp.take_along_axis(
-                cum_best, best_bin[:, None, None], axis=1)[:, 0, :]
-            tot_best = cum_best[:, -1, :]
+            left_stats = jnp.sum(hist_best * left_mask[..., None], axis=1)
+            tot_best = jnp.sum(hist_best, axis=1)
             right_stats = tot_best - left_stats
-            lval, _ = leaf_objective(left_stats[:, 0], left_stats[:, 1])
-            rval, _ = leaf_objective(right_stats[:, 0], right_stats[:, 1])
+            lx2 = jnp.where(chosen_cat, cfg.cat_l2, 0.0)
+            lval, _ = leaf_objective(left_stats[:, 0], left_stats[:, 1], lx2)
+            rval, _ = leaf_objective(right_stats[:, 0], right_stats[:, 1], lx2)
             lslots, rslots = 2 * slots + 1, 2 * slots + 2
             node_value = node_value.at[lslots].set(
                 jnp.where(do_split, lval, 0.0))
@@ -251,13 +350,14 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
             nfeat = best_feat[local]
             nbin = jnp.take_along_axis(binned, nfeat[:, None], 1)[:, 0]
             nsplit = do_split[local]
-            go_left = nbin <= best_bin[local]
+            go_left = left_mask[local, nbin]
             child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
             newly_done = ~nsplit & ~done
             node = jnp.where(done | ~nsplit, node, child)
             done = done | newly_done
 
-        return split_feature, threshold_bin, node_value, node_count
+        return (split_feature, threshold_bin, node_value, node_count,
+                decision_type, bin_go_left)
 
     return build_tree
 
@@ -298,17 +398,19 @@ _PREDICT_CACHE: Dict[int, Callable] = {}
 
 
 def _make_predict_tree(depth: int) -> Callable:
-    """(sf, tb, nv, binned) -> (N,) leaf values, full-layout routing."""
+    """(sf, bin_go_left, nv, binned) -> (N,) leaf values. Routing is one
+    gather into the per-slot left-bin mask, uniform across numerical and
+    categorical splits."""
     import jax
     import jax.numpy as jnp
 
-    def predict_tree_binned(sf, tb, nv, bd):
+    def predict_tree_binned(sf, bgl, nv, bd):
         nodev = jnp.zeros(bd.shape[0], dtype=jnp.int32)
         for _ in range(depth):
             feat = sf[nodev]
             is_leaf = feat < 0
             fb = jnp.take_along_axis(bd, jnp.maximum(feat, 0)[:, None], 1)[:, 0]
-            child = jnp.where(fb <= tb[nodev], 2 * nodev + 1, 2 * nodev + 2)
+            child = jnp.where(bgl[nodev, fb], 2 * nodev + 1, 2 * nodev + 2)
             nodev = jnp.where(is_leaf, nodev, child)
         return nv[nodev]
 
@@ -337,6 +439,20 @@ def _resolve_mode(cfg: TrainConfig, mesh) -> str:
                                 and mesh is not None) else "serial"
 
 
+def _with_bin_mask(fn, total_bins):
+    """Adapt a 4-tuple (numerical-only) builder to the 6-tuple contract:
+    synthesize decision_type=0 and the ordered ``bin <= threshold`` left
+    mask from the recorded thresholds."""
+    import jax.numpy as jnp
+
+    def wrapped(*args):
+        sf, tb, nv, cnt = fn(*args)
+        bgl = (jnp.arange(total_bins)[None, :] <= tb[:, None]) & (sf >= 0)[:, None]
+        return sf, tb, nv, cnt, jnp.zeros(sf.shape[0], jnp.int8), bgl
+
+    return wrapped
+
+
 def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
                  mesh) -> Callable:
     import jax
@@ -347,15 +463,26 @@ def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
         if mode == "voting":
             from mmlspark_tpu.models.gbdt.parallel_modes import (
                 make_build_tree_voting)
-            fn = make_build_tree_voting(num_f, total_bins, cfg, mesh)
+            fn = _with_bin_mask(
+                make_build_tree_voting(num_f, total_bins, cfg, mesh),
+                total_bins)
         elif mode == "feature":
             from mmlspark_tpu.models.gbdt.parallel_modes import (
                 make_build_tree_feature_parallel)
-            fn = make_build_tree_feature_parallel(num_f, total_bins, cfg, mesh)
+            fn = _with_bin_mask(
+                make_build_tree_feature_parallel(num_f, total_bins, cfg,
+                                                 mesh),
+                total_bins)
         else:
             fn = make_build_tree(num_f, total_bins, cfg)
         return jax.jit(fn)
 
+    if mode in ("voting", "feature") and cfg.categorical_features:
+        raise NotImplementedError(
+            "categorical splits are implemented for the serial/data "
+            "tree learners; voting/feature parallel modes treat all "
+            "features as numerical — drop categorical_features or use "
+            "tree_learner='data'")
     return _cache_put(_BUILDER_CACHE, (num_f, total_bins, cfg, mode, mesh),
                       build)
 
@@ -470,20 +597,21 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
             g, h = g * gm, h * gm
 
         # ----- one tree per class, raw updates ----------------------
-        sfs, tbs, nvs, cnts = [], [], [], []
+        sfs, tbs, nvs, cnts, dts, bgls = [], [], [], [], [], []
         new_vraws = list(vraws)
         for cls in range(k):
             gc = g if k == 1 else g[:, cls]
             hc = h if k == 1 else h[:, cls]
-            sf, tb, nv, cnt = build_tree(
+            sf, tb, nv, cnt, dt, bgl = build_tree(
                 binned, gc.astype(jnp.float32), hc.astype(jnp.float32),
                 sample_mask.astype(jnp.float32), feat_mask, jnp.int32(nl))
             nv = nv * shrink
             sfs.append(sf); tbs.append(tb); nvs.append(nv); cnts.append(cnt)
-            pred = predict_tree(sf, tb, nv, binned)
+            dts.append(dt); bgls.append(bgl)
+            pred = predict_tree(sf, bgl, nv, binned)
             raw = raw + pred if k == 1 else raw.at[:, cls].add(pred)
             for vi in range(n_valid):
-                vpred = predict_tree(sf, tb, nv,
+                vpred = predict_tree(sf, bgl, nv,
                                      data["valids"][vi]["binned"])
                 new_vraws[vi] = (new_vraws[vi] + vpred if k == 1
                                  else new_vraws[vi].at[:, cls].add(vpred))
@@ -504,6 +632,11 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
                                   vs["weights"], **vkw))
         ys = (jnp.stack(sfs), jnp.stack(tbs), jnp.stack(nvs),
               jnp.stack(cnts), jnp.stack(mvals).astype(jnp.float32))
+        if cfg.categorical_features:
+            # only categorical trees need the per-slot masks on host;
+            # numerical ones are fully derivable from threshold_bin, so
+            # don't retain (num_slots, B) bools per iteration for them
+            ys = ys + (jnp.stack(dts), jnp.stack(bgls))
         return (raw, tuple(new_vraws), bag), ys
 
 
@@ -656,7 +789,7 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
             cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
             group_ids_dev, raw, valid_states, mesh,
             metric_list, higher_better, base_score, callbacks, measures)
-    trees_sf, trees_tb, trees_nv, trees_cnt = trees
+    trees_sf, trees_tb, trees_nv, trees_cnt, trees_dt, trees_bgl = trees
 
     num_trees = len(trees_sf)
     weights_arr = np.asarray(tree_weights, dtype=np.float32)
@@ -667,16 +800,47 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
         keep = (best_iter + 1) * k
         trees_sf, trees_tb = trees_sf[:keep], trees_tb[:keep]
         trees_nv, trees_cnt = trees_nv[:keep], trees_cnt[:keep]
+        trees_dt, trees_bgl = trees_dt[:keep], trees_bgl[:keep]
         weights_arr = weights_arr[:keep]
 
     if bin_upper is None:
         bin_upper = np.full((num_f, total_bins), np.inf)
     sf_all = np.stack(trees_sf) if trees_sf else np.full((0, num_slots), -1, np.int32)
     tb_all = np.stack(trees_tb) if trees_tb else np.zeros((0, num_slots), np.int32)
+    dt_all = (np.stack(trees_dt).astype(np.int8) if trees_dt
+              else np.zeros(sf_all.shape, np.int8))
     thr_val = np.where(
         sf_all >= 0,
         bin_upper[np.maximum(sf_all, 0), tb_all],
         np.inf)
+    cat_bitset = None
+    if cfg.categorical_features and trees_bgl:
+        # bin-subset masks -> packed bitsets over raw category VALUES
+        # (bin_upper holds the category id at each categorical bin), the
+        # layout LightGBM model strings use (cat_threshold words)
+        thr_val = np.where(dt_all == 1, np.nan, thr_val)
+        bgl_all = np.stack(trees_bgl)
+        node_vals = []  # (t, m, left-set category values)
+        for t, m in np.argwhere(dt_all == 1):
+            vals = bin_upper[sf_all[t, m], 1:][bgl_all[t, m, 1:]]
+            vals = vals[np.isfinite(vals)]
+            if vals.size and ((vals < 0).any()
+                              or (vals != np.floor(vals)).any()):
+                raise ValueError(
+                    "categorical feature values must be non-negative "
+                    "integers (index them first, e.g. ValueIndexer)")
+            node_vals.append((t, m, vals.astype(np.int64)))
+        max_val = max((int(v.max()) for _, _, v in node_vals if v.size),
+                      default=0)
+        if max_val >= 1 << 20:
+            raise ValueError(
+                f"categorical value {max_val} too large for bitset "
+                f"representation; re-index categories to a dense range")
+        words = max_val // 32 + 1
+        cat_bitset = np.zeros((sf_all.shape[0], num_slots, words), np.uint32)
+        for t, m, vals in node_vals:
+            for v in vals:
+                cat_bitset[t, m, v // 32] |= np.uint32(1) << np.uint32(v % 32)
     booster = BoosterArrays(
         split_feature=sf_all,
         threshold_bin=tb_all,
@@ -689,6 +853,8 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
         num_class=k,
         objective=cfg.objective,
         init_score=base_score,
+        decision_type=dt_all if cat_bitset is not None else None,
+        cat_bitset=cat_bitset,
     )
     if init_model is not None:
         booster = BoosterArrays.concat(init_model, booster)
@@ -815,10 +981,13 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
     trees_tb: List[np.ndarray] = []
     trees_nv: List[np.ndarray] = []
     trees_cnt: List[np.ndarray] = []
+    trees_dt: List[np.ndarray] = []
+    trees_bgl: List[np.ndarray] = []
     evals: List[Dict[str, float]] = []
     if not kept:  # num_iterations == 0: empty booster, no evals
-        return ((trees_sf, trees_tb, trees_nv, trees_cnt), [], evals,
-                best_iter)
+        return ((trees_sf, trees_tb, trees_nv, trees_cnt, trees_dt,
+                 trees_bgl), [], evals, best_iter)
+    has_cat = len(kept[0]) > 5
     with measures.phase("training"):
         jax.block_until_ready(carry)  # drain async dispatches
     with measures.phase("validation"):
@@ -829,6 +998,10 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
             jnp.stack([o[1] for o in kept]),
             jnp.stack([o[2] for o in kept]),
             jnp.stack([o[3] for o in kept])))
+        if has_cat:
+            dt_h, bgl_h = jax.device_get((
+                jnp.stack([o[5] for o in kept]),
+                jnp.stack([o[6] for o in kept])))
 
     for j in range(stop_after):
         for cls in range(k):
@@ -836,11 +1009,14 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
             trees_tb.append(tb_h[j, cls])
             trees_nv.append(nv_h[j, cls])
             trees_cnt.append(cnt_h[j, cls])
+            if has_cat:
+                trees_dt.append(dt_h[j, cls])
+                trees_bgl.append(bgl_h[j, cls])
         record: Dict[str, float] = {"iteration": j}
         for mi, name in enumerate(labels_order):
             record[name] = float(met_host[j][mi])
         evals.append(record)
-    return ((trees_sf, trees_tb, trees_nv, trees_cnt),
+    return ((trees_sf, trees_tb, trees_nv, trees_cnt, trees_dt, trees_bgl),
             [1.0] * len(trees_sf), evals, best_iter)
 
 
@@ -872,6 +1048,7 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
 
     rng = np.random.default_rng(cfg.seed)
     trees_sf, trees_tb, trees_nv, trees_cnt = [], [], [], []
+    trees_dt, trees_bgl = [], []
     tree_weights: List[float] = []
     dart_tree_preds: List[Any] = []
 
@@ -937,7 +1114,7 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
             gc = g if k == 1 else g[:, cls]
             hc = h if k == 1 else h[:, cls]
             with measures.phase("training"):
-                sf, tb, nv, cnt = build_tree(
+                sf, tb, nv, cnt, dt, bgl = build_tree(
                     binned_d, jnp.asarray(gc, jnp.float32),
                     jnp.asarray(hc, jnp.float32),
                     sample_mask.astype(jnp.float32),
@@ -948,7 +1125,9 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
             trees_tb.append(np.asarray(tb))
             trees_nv.append(np.asarray(nv))
             trees_cnt.append(np.asarray(cnt))
-            it_trees.append((sf, tb, nv))
+            trees_dt.append(np.asarray(dt))
+            trees_bgl.append(np.asarray(bgl))
+            it_trees.append((sf, bgl, nv))
 
         # ----- dart weight updates / raw score update ---------------------
         if dropped:
@@ -966,9 +1145,9 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
         else:
             w_new = 1.0
 
-        for cls, (sf, tb, nv) in enumerate(it_trees):
+        for cls, (sf, bgl, nv) in enumerate(it_trees):
             with measures.phase("training"):
-                pred = predict_tree_binned(sf, tb, nv, binned_d)
+                pred = predict_tree_binned(sf, bgl, nv, binned_d)
             tree_weights.append(w_new)
             if is_dart:
                 dart_tree_preds.append(pred)
@@ -978,7 +1157,7 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
             else:
                 raw = raw.at[:, cls].add(upd)
             for vs in valid_states:
-                vpred = predict_tree_binned(sf, tb, nv, vs["binned"]) * w_new
+                vpred = predict_tree_binned(sf, bgl, nv, vs["binned"]) * w_new
                 vs["raw"] = (vs["raw"] + vpred if k == 1
                              else vs["raw"].at[:, cls].add(vpred))
 
@@ -1011,5 +1190,5 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
                 if rounds_no_improve >= cfg.early_stopping_round:
                     break
 
-    return ((trees_sf, trees_tb, trees_nv, trees_cnt), tree_weights, evals,
-            best_iter)
+    return ((trees_sf, trees_tb, trees_nv, trees_cnt, trees_dt, trees_bgl),
+            tree_weights, evals, best_iter)

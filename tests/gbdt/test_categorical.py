@@ -1,0 +1,190 @@
+"""Categorical split handling (VERDICT r2 #4).
+
+Parity target: LightGBM's categorical algorithm surfaced through
+``categoricalSlotIndexes`` (params/LightGBMParams.scala categorical
+group, core/schema/Categoricals.scala) — per-category histograms,
+gradient-ratio sorted subset selection, bitset export in the model
+string, set-membership routing.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.gbdt.booster import BoosterArrays
+from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
+from mmlspark_tpu.ops.binning import BinMapper
+
+
+def _cat_dataset(n=4000, k=24, seed=0):
+    """Label depends on membership of a scattered category subset, so no
+    single ordered threshold separates it."""
+    rng = np.random.default_rng(seed)
+    cats = rng.integers(0, k, size=n)
+    good = np.array([1, 4, 7, 11, 14, 17, 20, 23])
+    noise = rng.normal(size=n)
+    y = (np.isin(cats, good) & (noise > -1.0)).astype(np.float64)
+    x = np.stack([cats.astype(np.float64), noise], axis=1)
+    return x, y, good
+
+
+def _fit(x, y, categorical, num_iterations=20, **kw):
+    cat_idx = [0] if categorical else []
+    mapper = BinMapper.fit(x, max_bin=64, categorical_features=cat_idx)
+    binned = mapper.transform(x)
+    cfg = TrainConfig(objective="binary", num_iterations=num_iterations,
+                      num_leaves=8, max_depth=3, min_data_in_leaf=5,
+                      max_bin=64, categorical_features=tuple(cat_idx), **kw)
+    result = train(binned, y, cfg, bin_upper=mapper.bin_upper_values(64))
+    return result, mapper
+
+
+def _accuracy(booster, x, y):
+    raw = np.asarray(booster.predict_jit()(x))
+    return float(((raw > 0) == (y > 0.5)).mean())
+
+
+class TestCategoricalSplits:
+    def test_categorical_beats_ordinal(self):
+        x, y, _ = _cat_dataset()
+        res_cat, _ = _fit(x, y, categorical=True)
+        res_ord, _ = _fit(x, y, categorical=False)
+        acc_cat = _accuracy(res_cat.booster, x, y)
+        acc_ord = _accuracy(res_ord.booster, x, y)
+        # scattered subset: set splits isolate it in depth-3 trees,
+        # ordered thresholds cannot
+        assert acc_cat > acc_ord + 0.02
+        assert acc_cat > 0.9
+
+    def test_decision_type_marks_cat_nodes(self):
+        x, y, _ = _cat_dataset()
+        res, _ = _fit(x, y, categorical=True, num_iterations=3)
+        b = res.booster
+        assert b.decision_type is not None and b.cat_bitset is not None
+        cat_nodes = (b.decision_type & 1) == 1
+        assert cat_nodes.any()
+        # cat nodes split on feature 0 and carry a nonempty bitset
+        assert (b.split_feature[cat_nodes] == 0).all()
+        assert (b.cat_bitset[cat_nodes] != 0).any(axis=-1).all()
+        # numerical nodes on feature 1 never flagged
+        num_nodes = (b.split_feature == 1)
+        assert (b.decision_type[num_nodes] == 0).all()
+
+    def test_binned_and_raw_prediction_agree(self):
+        x, y, _ = _cat_dataset(n=1500)
+        res, mapper = _fit(x, y, categorical=True, num_iterations=5)
+        raw_scores = np.asarray(res.booster.predict_jit()(x))
+        # independent numpy walk over the exported arrays
+        b = res.booster
+        acc = np.full(len(x), b.init_score, dtype=np.float64)
+        for t in range(b.num_trees):
+            node = np.zeros(len(x), dtype=np.int64)
+            for _ in range(b.max_depth):
+                feat = b.split_feature[t][node]
+                leaf = feat < 0
+                fx = x[np.arange(len(x)), np.maximum(feat, 0)]
+                is_cat = (b.decision_type[t][node] & 1) == 1
+                vi = fx.astype(np.int64)
+                w = b.cat_bitset.shape[2]
+                ok = (fx >= 0) & (fx < w * 32) & (fx == np.floor(fx))
+                member = np.zeros(len(x), dtype=bool)
+                iv = np.clip(vi, 0, w * 32 - 1)
+                words = b.cat_bitset[t][node, iv // 32]
+                member = ((words >> (iv % 32).astype(np.uint32)) & 1) == 1
+                go_left = np.where(is_cat, ok & member,
+                                   np.isnan(fx) | (fx <= b.threshold_value[t][node]))
+                child = np.where(go_left, 2 * node + 1, 2 * node + 2)
+                node = np.where(leaf, node, child)
+            acc += b.node_value[t][node] * b.tree_weights[t]
+        np.testing.assert_allclose(raw_scores, acc, atol=1e-5)
+
+    def test_model_string_roundtrip_with_cats(self):
+        x, y, _ = _cat_dataset(n=1200)
+        res, _ = _fit(x, y, categorical=True, num_iterations=4)
+        text = res.booster.save_model_string()
+        assert "num_cat=0" not in text.split("Tree=0")[1].split("Tree=1")[0] \
+            or True  # at least one tree should carry cats; checked below
+        assert any(f"num_cat={n}" in text for n in range(1, 20))
+        assert "cat_boundaries=" in text and "cat_threshold=" in text
+        loaded = BoosterArrays.load_model_string(text)
+        assert loaded.has_categorical
+        p0 = np.asarray(res.booster.predict_jit()(x))
+        p1 = np.asarray(loaded.predict_jit()(x))
+        np.testing.assert_allclose(p0, p1, rtol=1e-5, atol=1e-5)
+
+    def test_unseen_and_missing_categories_route_right(self):
+        x, y, _ = _cat_dataset(n=1500)
+        res, _ = _fit(x, y, categorical=True, num_iterations=5)
+        b = res.booster
+        # craft rows whose cat value was never seen (or missing)
+        x_unseen = x.copy()[:4]
+        x_unseen[:, 0] = [999.0, -5.0, 3.5, np.nan]
+        # routing must take the right-child path at every cat node: same
+        # as any seen value NOT in the left set. Just assert it runs and
+        # produces finite outputs (the walk would crash/UB on a bad
+        # gather otherwise) and that NaN == unseen-category behavior.
+        p = np.asarray(b.predict_jit()(x_unseen))
+        assert np.isfinite(p).all()
+        assert p[0] == pytest.approx(p[3], abs=1e-6)  # 999 ≡ NaN (both right)
+
+    def test_onehot_mode_low_cardinality(self):
+        rng = np.random.default_rng(3)
+        n = 2000
+        cats = rng.integers(0, 3, size=n)  # 3 cats <= max_cat_to_onehot
+        y = (cats == 1).astype(np.float64)
+        x = cats[:, None].astype(np.float64)
+        mapper = BinMapper.fit(x, max_bin=16, categorical_features=[0])
+        binned = mapper.transform(x)
+        cfg = TrainConfig(objective="binary", num_iterations=3,
+                          num_leaves=4, max_depth=2, min_data_in_leaf=5,
+                          max_bin=16, categorical_features=(0,))
+        res = train(binned, y, cfg, bin_upper=mapper.bin_upper_values(16))
+        b = res.booster
+        assert b.has_categorical
+        # root must isolate category 1 alone on one side
+        root_bits = b.cat_bitset[0, 0]
+        vals = [v for v in range(16) if (root_bits[v // 32] >> (v % 32)) & 1]
+        assert vals == [1]
+        acc = _accuracy(b, x, y)
+        assert acc > 0.99
+
+    def test_estimator_api_with_categoricals(self):
+        from mmlspark_tpu.core.dataframe import DataFrame
+        from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+        x, y, _ = _cat_dataset(n=1200)
+        df = DataFrame({"features": x, "label": y})
+        model = LightGBMClassifier(
+            numIterations=8, numLeaves=8, maxDepth=3, maxBin=64,
+            categoricalSlotIndexes=[0]).fit(df)
+        out = model.transform(df)
+        acc = float((out["prediction"] == y).mean())
+        assert acc > 0.85
+        # native model string round-trips through the model API
+        text = model.get_model_string()
+        reloaded = type(model).load_native_model_from_string(text)
+        out2 = reloaded.transform(df)
+        np.testing.assert_allclose(out["prediction"], out2["prediction"])
+
+    def test_voting_mode_rejects_categoricals(self, mesh8):
+        x, y, _ = _cat_dataset(n=600)
+        mapper = BinMapper.fit(x, max_bin=16, categorical_features=[0])
+        binned = mapper.transform(x)
+        cfg = TrainConfig(objective="binary", num_iterations=2,
+                          num_leaves=4, max_depth=2, max_bin=16,
+                          categorical_features=(0,), tree_learner="voting")
+        with pytest.raises(NotImplementedError):
+            train(binned, y, cfg, bin_upper=mapper.bin_upper_values(16),
+                  mesh=mesh8)
+
+    def test_min_data_in_leaf_respected(self):
+        x, y, _ = _cat_dataset(n=800)
+        res, _ = _fit(x, y, categorical=True, num_iterations=5,
+                      min_gain_to_split=0.0)
+        b = res.booster
+        internal = b.split_feature >= 0
+        left = b.count[:, 1::2] if b.num_nodes > 1 else None
+        # every realized child of a split has >= min_data_in_leaf rows
+        for t in range(b.num_trees):
+            for m in np.nonzero(internal[t])[0]:
+                assert b.count[t, 2 * m + 1] >= 5
+                assert b.count[t, 2 * m + 2] >= 5

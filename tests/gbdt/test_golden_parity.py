@@ -1,0 +1,219 @@
+"""Golden parity vs the LightGBM native model format and real datasets
+(VERDICT r2 #3).
+
+Two legs:
+
+1. A committed LightGBM-format model string
+   (``fixtures/lightgbm_golden_model.txt`` — v4 text layout exactly as
+   ``LGBM_BoosterSaveModel`` emits it, incl. categorical
+   cat_boundaries/cat_threshold bitsets). An *independent* parser+walker
+   in this file — structurally different from
+   ``BoosterArrays.load_model_string``'s full-layout placement — walks
+   the explicit child-pointer arrays; both must produce identical
+   predictions.
+
+2. Accuracy regression on real datasets (sklearn's bundled
+   breast_cancer / diabetes) against sklearn's
+   HistGradientBoosting* — the same histogram-GBDT algorithm family the
+   reference wraps — mirroring BASELINE.md's tolerance rows
+   (benchmarks_VerifyLightGBMClassifierBulkBasic.csv).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.gbdt.booster import BoosterArrays
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "lightgbm_golden_model.txt")
+
+
+def _parse_trees(text):
+    """Minimal independent parser: list of dicts of raw arrays."""
+    trees = []
+    block = None
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("Tree="):
+            block = {}
+            trees.append(block)
+        elif line == "end of trees":
+            block = None
+        elif block is not None and "=" in line:
+            k, v = line.split("=", 1)
+            block[k] = v
+    return trees
+
+
+def _walk(tree, x):
+    """Reference walker over LightGBM's child-pointer encoding:
+    code >= 0 -> internal node, code < 0 -> leaf ~code."""
+    sf = list(map(int, tree["split_feature"].split()))
+    thr = list(map(float, tree["threshold"].split()))
+    left = list(map(int, tree["left_child"].split()))
+    right = list(map(int, tree["right_child"].split()))
+    dec = list(map(int, tree["decision_type"].split()))
+    leaf_value = list(map(float, tree["leaf_value"].split()))
+    bounds = (list(map(int, tree["cat_boundaries"].split()))
+              if "cat_boundaries" in tree else [])
+    words = (list(map(int, tree["cat_threshold"].split()))
+             if "cat_threshold" in tree else [])
+
+    out = np.zeros(len(x))
+    for i, row in enumerate(x):
+        code = 0
+        while code >= 0:
+            v = row[sf[code]]
+            if dec[code] & 1:
+                cat_idx = int(thr[code])
+                lo, hi = bounds[cat_idx], bounds[cat_idx + 1]
+                iv = int(v) if np.isfinite(v) and v == int(v) and v >= 0 else -1
+                in_set = (0 <= iv < (hi - lo) * 32
+                          and (words[lo + iv // 32] >> (iv % 32)) & 1)
+                code = left[code] if in_set else right[code]
+            else:
+                go_left = np.isnan(v) or v <= thr[code]
+                code = left[code] if go_left else right[code]
+        out[i] += leaf_value[~code]
+    return out
+
+
+@pytest.fixture(scope="module")
+def golden_text():
+    with open(FIXTURE) as f:
+        return f.read()
+
+
+def test_fixture_loads_with_categoricals(golden_text):
+    b = BoosterArrays.load_model_string(golden_text)
+    assert b.num_trees == 2
+    assert b.num_features == 5
+    assert b.has_categorical
+    # tree 1 root splits on the categorical feature 4
+    assert (b.decision_type[1] & 1).sum() == 2
+
+
+def test_golden_predictions_match_independent_walker(golden_text):
+    rng = np.random.default_rng(11)
+    n = 500
+    x = rng.normal(size=(n, 5))
+    x[:, 4] = rng.integers(-1, 9, size=n)  # cats incl. unseen -1, 8
+    x[:5, 0] = np.nan                      # numerical missing
+    x[5:8, 4] = np.nan                     # categorical missing
+
+    trees = _parse_trees(golden_text)
+    want = _walk(trees[0], x) + _walk(trees[1], x)
+
+    b = BoosterArrays.load_model_string(golden_text)
+    got = np.asarray(b.predict_jit()(x))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_golden_roundtrip_preserves_predictions(golden_text):
+    rng = np.random.default_rng(12)
+    x = rng.normal(size=(300, 5))
+    x[:, 4] = rng.integers(0, 8, size=300)
+    b = BoosterArrays.load_model_string(golden_text)
+    b2 = BoosterArrays.load_model_string(b.save_model_string())
+    np.testing.assert_allclose(np.asarray(b.predict_jit()(x)),
+                               np.asarray(b2.predict_jit()(x)),
+                               rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# real-dataset accuracy vs sklearn HistGradientBoosting
+# ---------------------------------------------------------------------------
+
+def _auc(scores, y):
+    order = np.argsort(scores)
+    ranks = np.empty(len(y))
+    ranks[order] = np.arange(1, len(y) + 1)
+    pos = y > 0.5
+    n1, n0 = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0)
+
+
+@pytest.fixture(scope="module")
+def breast_cancer():
+    from sklearn.datasets import load_breast_cancer
+    d = load_breast_cancer()
+    rng = np.random.default_rng(0)
+    idx = rng.permutation(len(d.target))
+    cut = int(0.75 * len(idx))
+    return (d.data[idx[:cut]], d.target[idx[:cut]].astype(np.float64),
+            d.data[idx[cut:]], d.target[idx[cut:]].astype(np.float64))
+
+
+def test_breast_cancer_auc_matches_sklearn_hgb(breast_cancer):
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+    xtr, ytr, xte, yte = breast_cancer
+    model = LightGBMClassifier(numIterations=100, numLeaves=31,
+                               learningRate=0.1).fit(
+        DataFrame({"features": xtr, "label": ytr}))
+    probs = model.transform(DataFrame({"features": xte, "label": yte}))
+    ours = _auc(probs["probability"][:, 1], yte)
+
+    ref = HistGradientBoostingClassifier(
+        max_iter=100, learning_rate=0.1, max_leaf_nodes=31,
+        early_stopping=False, random_state=0).fit(xtr, ytr)
+    theirs = _auc(ref.predict_proba(xte)[:, 1], yte)
+
+    assert ours > 0.95
+    # BASELINE.md's AUC rows carry +-0.07; hold a tighter bar vs the
+    # measured comparator on the same split
+    assert ours >= theirs - 0.02, (ours, theirs)
+
+
+def test_breast_cancer_goss_tracks_gbdt(breast_cancer):
+    """GOSS amplification/min_data semantics: quality must track plain
+    gbdt closely (pins VERDICT r2 weak #9)."""
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+    xtr, ytr, xte, yte = breast_cancer
+    aucs = {}
+    for boosting in ("gbdt", "goss"):
+        model = LightGBMClassifier(numIterations=60, numLeaves=31,
+                                   boostingType=boosting).fit(
+            DataFrame({"features": xtr, "label": ytr}))
+        probs = model.transform(DataFrame({"features": xte, "label": yte}))
+        aucs[boosting] = _auc(probs["probability"][:, 1], yte)
+    assert aucs["goss"] > 0.95
+    assert abs(aucs["goss"] - aucs["gbdt"]) < 0.03, aucs
+
+
+def test_diabetes_l2_matches_sklearn_hgb():
+    from sklearn.datasets import load_diabetes
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+
+    d = load_diabetes()
+    rng = np.random.default_rng(1)
+    idx = rng.permutation(len(d.target))
+    cut = int(0.75 * len(idx))
+    xtr, ytr = d.data[idx[:cut]], d.target[idx[:cut]]
+    xte, yte = d.data[idx[cut:]], d.target[idx[cut:]]
+
+    model = LightGBMRegressor(numIterations=200, numLeaves=15,
+                              learningRate=0.05).fit(
+        DataFrame({"features": xtr, "label": ytr}))
+    pred = model.transform(
+        DataFrame({"features": xte, "label": yte}))["prediction"]
+    ours = float(np.mean((pred - yte) ** 2))
+
+    ref = HistGradientBoostingRegressor(
+        max_iter=200, learning_rate=0.05, max_leaf_nodes=15,
+        early_stopping=False, random_state=0).fit(xtr, ytr)
+    theirs = float(np.mean((ref.predict(xte) - yte) ** 2))
+
+    # energyefficiency L2 rows in BASELINE.md carry +-1.0 on values ~4;
+    # the same relative slack vs the measured comparator
+    assert ours <= theirs * 1.25, (ours, theirs)
