@@ -126,6 +126,7 @@ class DeepEstimator(Estimator, _DeepParams):
         model = self._make_model(module, jax.device_get(params), classes)
         model.train_seconds = watch.elapsed
         model.loss_history = history
+        model._mesh = mesh
         return model
 
 
@@ -139,6 +140,13 @@ class DeepModel(Model, _DeepParams):
     _module = None
     _params = None
     _classes: Optional[np.ndarray] = None
+    _mesh = None
+
+    def set_mesh(self, mesh) -> "DeepModel":
+        """Score with batches sharded over the mesh 'dp' axis (the
+        embarrassing-parallel inference mode, ONNXModel.scala:242-251)."""
+        self._mesh = mesh
+        return self
 
     def _init_state(self, module, params, classes):
         self._module = module
@@ -191,15 +199,28 @@ class DeepModel(Model, _DeepParams):
             self._apply_jit = jax.jit(
                 lambda p, xb: self._module.apply(p, xb))
         apply = self._apply_jit
+        if self._mesh is not None:
+            # dp-sharded scoring: params replicate, rows shard; round
+            # the chunk size so full chunks tile the dp axis evenly
+            from mmlspark_tpu.parallel.mesh import axis_size
+            dp = axis_size(self._mesh, DATA_AXIS)
+            batch = max(((batch + dp - 1) // dp) * dp, dp)
         outs = []
         for s in range(0, len(x), batch):
             xb = x[s:s + batch]
-            pad = 0
-            if len(xb) < batch and len(x) > batch:
-                pad = batch - len(xb)
-                xb = np.concatenate([xb, np.repeat(xb[-1:], pad, axis=0)])
-            o = np.asarray(apply(self._params, jnp.asarray(xb)))
-            outs.append(o[:len(o) - pad] if pad else o)
+            if self._mesh is not None:
+                from mmlspark_tpu.parallel.inference import sharded_apply
+                o = sharded_apply(lambda b: apply(self._params, b), xb,
+                                  self._mesh)
+            else:
+                pad = 0
+                if len(xb) < batch and len(x) > batch:
+                    pad = batch - len(xb)
+                    xb = np.concatenate([xb, np.repeat(xb[-1:], pad,
+                                                       axis=0)])
+                o = np.asarray(apply(self._params, jnp.asarray(xb)))
+                o = o[:len(o) - pad] if pad else o
+            outs.append(o)
         return np.concatenate(outs)
 
     def _transform(self, dataset: DataFrame) -> DataFrame:

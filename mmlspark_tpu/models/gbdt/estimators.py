@@ -281,6 +281,20 @@ class _LightGBMModelBase(Model, _LightGBMParams):
     train_measures: Optional[InstrumentationMeasures] = None
     evals_result: Optional[List[Dict[str, float]]] = None
     best_iteration: int = -1
+    _mesh = None
+
+    def set_mesh(self, mesh) -> "_LightGBMModelBase":
+        """Score with rows sharded over the mesh 'dp' axis (embarrassing
+        parallel inference, ONNXModel.scala:242-251 analog). Inherited
+        from the estimator's mesh at fit time."""
+        self._mesh = mesh
+        return self
+
+    def _score(self, fn, x: np.ndarray) -> np.ndarray:
+        if self._mesh is not None:
+            from mmlspark_tpu.parallel.inference import sharded_apply
+            return sharded_apply(fn, x, self._mesh)
+        return np.asarray(fn(x))
 
     def _init_empty(self):
         self.booster = None
@@ -327,11 +341,11 @@ class _LightGBMModelBase(Model, _LightGBMParams):
 
     def _maybe_extra_cols(self, df: DataFrame, x: np.ndarray) -> DataFrame:
         if self.is_set("leafPredictionCol"):
-            leaves = np.asarray(self.booster.leaf_index_jit()(x))
+            leaves = self._score(self.booster.leaf_index_jit(), x)
             df = df.with_column(self.get("leafPredictionCol"),
                                 leaves.astype(np.float64))
         if self.is_set("featuresShapCol"):
-            contribs = np.asarray(self.booster.contrib_jit()(x))
+            contribs = self._score(self.booster.contrib_jit(), x)
             df = df.with_column(self.get("featuresShapCol"),
                                 contribs.astype(np.float64))
         return df
@@ -384,6 +398,7 @@ class LightGBMClassifier(_LightGBMBase):
             **{k: v for k, v in self._paramMap.items()
                if LightGBMClassificationModel.has_param(k)})
         model.booster = result.booster
+        model._mesh = self._mesh
         model.num_classes = num_class
         model.classes_ = classes
         model.train_measures = measures
@@ -419,7 +434,7 @@ class LightGBMClassificationModel(_LightGBMModelBase):
         import jax.numpy as jnp
 
         x = self._features(df)
-        raw = np.asarray(self.booster.predict_jit()(x))
+        raw = self._score(self.booster.predict_jit(), x)
         if raw.ndim == 1:  # binary: margins for [neg, pos]
             raw2 = np.stack([-raw, raw], axis=1)
             prob = 1.0 / (1.0 + np.exp(-raw))
@@ -467,6 +482,7 @@ class LightGBMRegressor(_LightGBMBase):
             **{k: v for k, v in self._paramMap.items()
                if LightGBMRegressionModel.has_param(k)})
         model.booster = result.booster
+        model._mesh = self._mesh
         model.train_measures = measures
         model.evals_result = result.evals
         model.best_iteration = result.best_iteration
@@ -476,7 +492,7 @@ class LightGBMRegressor(_LightGBMBase):
 class LightGBMRegressionModel(_LightGBMModelBase):
     def _transform(self, df: DataFrame) -> DataFrame:
         x = self._features(df)
-        raw = np.asarray(self.booster.predict_jit()(x))
+        raw = self._score(self.booster.predict_jit(), x)
         if self.booster.objective in ("poisson", "gamma", "tweedie"):
             raw = np.exp(raw)
         out = df.with_column(self.get("predictionCol"), raw.astype(np.float64))
@@ -506,6 +522,7 @@ class LightGBMRanker(_LightGBMBase):
             **{k: v for k, v in self._paramMap.items()
                if LightGBMRankerModel.has_param(k)})
         model.booster = result.booster
+        model._mesh = self._mesh
         model.train_measures = measures
         model.evals_result = result.evals
         model.best_iteration = result.best_iteration
@@ -515,7 +532,7 @@ class LightGBMRanker(_LightGBMBase):
 class LightGBMRankerModel(_LightGBMModelBase):
     def _transform(self, df: DataFrame) -> DataFrame:
         x = self._features(df)
-        raw = np.asarray(self.booster.predict_jit()(x))
+        raw = self._score(self.booster.predict_jit(), x)
         out = df.with_column(self.get("predictionCol"), raw.astype(np.float64))
         return self._maybe_extra_cols(out, x)
 

@@ -555,13 +555,14 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
         base_key = data["key"]
         shrink = 1.0 if is_rf else data["lr"]
         n = labels.shape[0]
+        rv = data["row_valid"]
         raw, vraws, bag = carry
         # ----- sampling masks (device RNG, deterministic by seed) ----
         if bag_active:
             kbag = jax.random.fold_in(jax.random.fold_in(base_key, 1), it)
             use_frac = rf_frac if is_rf else frac
             fresh = (jax.random.uniform(kbag, (n,)) < use_frac
-                     ).astype(jnp.float32)
+                     ).astype(jnp.float32) * rv
             if freq > 0:
                 refresh = (it % freq) == 0
             else:
@@ -585,7 +586,9 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
         sample_mask = bag
         if is_goss:
             absg = jnp.abs(g) if k == 1 else jnp.sum(jnp.abs(g), axis=1)
-            thr = jnp.quantile(absg, 1.0 - cfg.top_rate)
+            # padded rows are excluded from the gradient quantile
+            thr = jnp.nanquantile(jnp.where(rv > 0, absg, jnp.nan),
+                                  1.0 - cfg.top_rate)
             big = absg >= thr
             kg = jax.random.fold_in(jax.random.fold_in(base_key, 3), it)
             small_keep = jax.random.uniform(kg, absg.shape) < (
@@ -705,8 +708,7 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
     depth = cfg.effective_depth
     num_slots = 2 ** (depth + 1) - 1
 
-    group_ids_dev = None if group_ids is None else jnp.asarray(group_ids)
-    if cfg.objective == "lambdarank" and group_ids_dev is None:
+    if cfg.objective == "lambdarank" and group_ids is None:
         raise ValueError("lambdarank requires group_ids")
 
     with measures.phase("dataPreparation"):
@@ -722,6 +724,42 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
                           if cfg.boost_from_average and cfg.objective != "lambdarank"
                           else 0.0)
         feature_mode = cfg.tree_learner == "feature" and mesh is not None
+        row_valid = None
+        if mesh is not None and not feature_mode:
+            # row sharding needs N divisible by the dp axis: pad with
+            # zero-weight rows masked out of sampling/histograms via
+            # ``row_valid`` (the device analog of the reference's
+            # empty-partition tolerance, BasePartitionTask.scala:134-137)
+            from mmlspark_tpu.parallel.mesh import axis_size
+            dp_size = axis_size(mesh, "dp")
+            rem = n % dp_size
+            if rem:
+                pad_n = dp_size - rem
+                binned = np.concatenate(
+                    [binned, np.repeat(binned[-1:], pad_n, axis=0)])
+                labels = np.concatenate(
+                    [np.asarray(labels, np.float64), np.zeros(pad_n)])
+                weights = np.concatenate(
+                    [np.asarray(weights, np.float64) if weights is not None
+                     else np.ones(n), np.zeros(pad_n)])
+                if group_ids is not None:
+                    # padded rows get their OWN group: in lambdarank a
+                    # pad row sharing a real group would form valid
+                    # pairs (and rank positions) with real rows even at
+                    # weight 0
+                    group_ids = np.concatenate(
+                        [group_ids,
+                         np.full(pad_n, np.max(group_ids) + 1,
+                                 dtype=np.asarray(group_ids).dtype)])
+                if init_raw is not None:
+                    init_raw = np.concatenate(
+                        [np.asarray(init_raw, np.float32).reshape(
+                            (n,) if k == 1 else (n, k)),
+                         np.zeros((pad_n,) if k == 1 else (pad_n, k),
+                                  np.float32)])
+                row_valid = np.concatenate(
+                    [np.ones(n, np.float32), np.zeros(pad_n, np.float32)])
+                n = n + pad_n
         if feature_mode:
             # feature_parallel: rows replicated, features sharded on fp
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -740,6 +778,8 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
         labels_d = dev_put(np.asarray(labels, dtype=np.float32))
         weights_d = None if weights is None else dev_put(
             np.asarray(weights, dtype=np.float32))
+        row_valid_d = None if row_valid is None else dev_put(row_valid)
+    group_ids_dev = None if group_ids is None else jnp.asarray(group_ids)
 
     # raw scores, (N,) or (N,K)
     raw_shape = (n,) if k == 1 else (n, k)
@@ -783,12 +823,13 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
             cfg, k, num_f, total_bins, depth, binned_d, labels_d, weights_d,
             group_ids_dev, raw, valid_states, custom_objective, mesh,
             metric_name, metric_list, higher_better, metric_kwargs,
-            base_score, callbacks, measures, n)
+            base_score, callbacks, measures, n, row_valid)
     else:
         trees, tree_weights, evals, best_iter = _train_scan(
             cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
             group_ids_dev, raw, valid_states, mesh,
-            metric_list, higher_better, base_score, callbacks, measures)
+            metric_list, higher_better, base_score, callbacks, measures,
+            row_valid_d)
     trees_sf, trees_tb, trees_nv, trees_cnt, trees_dt, trees_bgl = trees
 
     num_trees = len(trees_sf)
@@ -863,7 +904,8 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
 
 def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
                 group_ids_dev, raw, valid_states, mesh,
-                metric_list, higher_better, base_score, callbacks, measures):
+                metric_list, higher_better, base_score, callbacks, measures,
+                row_valid_d=None):
     """Fused device loop: one async dispatch per iteration, zero host
     syncs inside the loop. Early stopping syncs the (tiny) metric matrix
     in blocks of ``early_stopping_round`` and truncates post hoc — trees
@@ -881,6 +923,7 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
         "labels": labels_d,
         "weights": weights_d if weights_d is not None else ones,
         "groups": group_ids_dev,
+        "row_valid": row_valid_d if row_valid_d is not None else ones,
         "base": jnp.float32(base_score),
         "key": jax.random.key(cfg.seed),
         "lr": jnp.float32(cfg.learning_rate),
@@ -893,7 +936,8 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
         } for vs in valid_states),
     }
     carry = (raw, tuple(vs["raw"] for vs in valid_states),
-             jnp.ones(labels_d.shape[0], jnp.float32))
+             row_valid_d if row_valid_d is not None
+             else jnp.ones(labels_d.shape[0], jnp.float32))
 
     # metric record layout must match the step body's stacking order
     labels_order = []
@@ -1024,7 +1068,7 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
                 weights_d, group_ids_dev, raw, valid_states,
                 custom_objective, mesh, metric_name, metric_list,
                 higher_better, metric_kwargs, base_score, callbacks,
-                measures, n):
+                measures, n, row_valid=None):
     """Per-iteration eager host loop. Used for (a) DART, whose
     dropped-tree set is a dynamically sized subset of all prior trees
     that doesn't fit a fixed-shape compiled step, and (b) custom
@@ -1057,13 +1101,15 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
     best_iter = -1
     rounds_no_improve = 0
 
-    bag_mask = np.ones(n, dtype=np.float32)
+    rv_host = (np.ones(n, dtype=np.float32) if row_valid is None
+               else np.asarray(row_valid, dtype=np.float32))
+    bag_mask = rv_host.copy()
     for it in range(cfg.num_iterations):
         # ----- sampling masks (host RNG, deterministic by seed) ----------
         if (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
                 and it % cfg.bagging_freq == 0) or (is_rf and it == 0):
             frac = cfg.bagging_fraction if cfg.bagging_fraction < 1.0 else 0.632
-            bag_mask = (rng.random(n) < frac).astype(np.float32)
+            bag_mask = (rng.random(n) < frac).astype(np.float32) * rv_host
         feat_mask = np.ones(num_f, dtype=np.float32)
         if cfg.feature_fraction < 1.0:
             keep = max(1, int(round(num_f * cfg.feature_fraction)))
@@ -1096,7 +1142,9 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
             g = jnp.asarray(g)
             h = jnp.asarray(h)
             absg = jnp.abs(g) if k == 1 else jnp.sum(jnp.abs(g), axis=1)
-            thr = jnp.quantile(absg, 1.0 - cfg.top_rate)
+            thr = jnp.nanquantile(
+                jnp.where(jnp.asarray(rv_host) > 0, absg, jnp.nan),
+                1.0 - cfg.top_rate)
             big = absg >= thr
             key = jax.random.fold_in(
                 jax.random.fold_in(jax.random.key(cfg.seed), 3), it)
@@ -1125,8 +1173,11 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
             trees_tb.append(np.asarray(tb))
             trees_nv.append(np.asarray(nv))
             trees_cnt.append(np.asarray(cnt))
-            trees_dt.append(np.asarray(dt))
-            trees_bgl.append(np.asarray(bgl))
+            if cfg.categorical_features:
+                # numerical-only masks are derivable from threshold_bin;
+                # don't pull (num_slots, B) bools to host per tree
+                trees_dt.append(np.asarray(dt))
+                trees_bgl.append(np.asarray(bgl))
             it_trees.append((sf, bgl, nv))
 
         # ----- dart weight updates / raw score update ---------------------

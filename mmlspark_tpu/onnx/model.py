@@ -41,10 +41,18 @@ class ONNXModel(Transformer):
 
     _graph: Optional[OnnxGraph] = None
     _run = None
+    _mesh = None
 
     def set_model_location(self, path: str) -> "ONNXModel":
         with open(path, "rb") as f:
             self._set(modelPayload=f.read())
+        return self
+
+    def set_mesh(self, mesh) -> "ONNXModel":
+        """Shard each minibatch's rows over the mesh 'dp' axis — the
+        embarrassing-parallel scoring mode (model broadcast + partition
+        scoring, onnx/ONNXModel.scala:242-251)."""
+        self._mesh = mesh
         return self
 
     def _ensure_graph(self):
@@ -92,7 +100,11 @@ class ONNXModel(Transformer):
                 elif batch.dtype == np.float64:
                     batch = batch.astype(np.float32)
                 feeds[input_name] = np.asarray(batch)
-            fetched = self._run(feeds)
+            if self._mesh is not None:
+                from mmlspark_tpu.parallel.inference import sharded_apply
+                fetched = sharded_apply(self._run, feeds, self._mesh)
+            else:
+                fetched = self._run(feeds)
             for out_col, tensor_name in fetch.items():
                 cols[out_col].append(np.asarray(fetched[tensor_name]))
 

@@ -101,8 +101,6 @@ class TestCategoricalSplits:
         x, y, _ = _cat_dataset(n=1200)
         res, _ = _fit(x, y, categorical=True, num_iterations=4)
         text = res.booster.save_model_string()
-        assert "num_cat=0" not in text.split("Tree=0")[1].split("Tree=1")[0] \
-            or True  # at least one tree should carry cats; checked below
         assert any(f"num_cat={n}" in text for n in range(1, 20))
         assert "cat_boundaries=" in text and "cat_threshold=" in text
         loaded = BoosterArrays.load_model_string(text)

@@ -1,0 +1,100 @@
+"""Mesh-sharded batch inference == single-device scoring (VERDICT r2
+#5; reference: broadcast-model partition scoring,
+onnx/ONNXModel.scala:242-251)."""
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+
+
+def test_gbdt_sharded_scoring_matches(mesh8, rng):
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+    n = 801  # deliberately not a multiple of 8 (padding path)
+    x = rng.normal(size=(n, 6))
+    y = (x[:, 0] + 0.3 * x[:, 1] > 0).astype(np.float64)
+    df = DataFrame({"features": x, "label": y})
+    model = LightGBMClassifier(numIterations=5, numLeaves=8,
+                               maxBin=32,
+                               leafPredictionCol="leaves",
+                               featuresShapCol="shap").fit(df)
+    single = model.transform(df)
+    sharded = model.set_mesh(mesh8).transform(df)
+    for col in ("prediction", "probability", "rawPrediction", "leaves",
+                "shap"):
+        np.testing.assert_allclose(
+            np.asarray(list(single[col]), np.float64),
+            np.asarray(list(sharded[col]), np.float64),
+            rtol=1e-6, atol=1e-6, err_msg=col)
+
+
+def test_gbdt_mesh_fit_pads_nondivisible_rows(mesh8, rng):
+    """Mesh training with N not divisible by the dp axis pads with
+    masked rows; the fitted model must match the unsharded fit."""
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+    n = 1001
+    x = rng.normal(size=(n, 5))
+    y = (x[:, 0] > 0).astype(np.float64)
+    df = DataFrame({"features": x, "label": y})
+    kw = dict(numIterations=5, numLeaves=8, maxBin=32)
+    sharded = LightGBMClassifier(**kw).set_mesh(mesh8).fit(df)
+    plain = LightGBMClassifier(**kw).fit(df)
+    ps = np.asarray(list(sharded.transform(df)["probability"]), np.float64)
+    pp = np.asarray(list(plain.transform(df)["probability"]), np.float64)
+    np.testing.assert_allclose(ps, pp, rtol=1e-4, atol=1e-5)
+    # bagging path also honors the mask (device RNG differs from host
+    # RNG, so just check it trains and scores finite)
+    bagged = LightGBMClassifier(baggingFraction=0.7, baggingFreq=1,
+                                **kw).set_mesh(mesh8).fit(df)
+    assert np.isfinite(np.asarray(
+        list(bagged.transform(df)["probability"]), np.float64)).all()
+
+
+def test_gbdt_fit_with_mesh_propagates_to_model(mesh8, rng):
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+
+    x = rng.normal(size=(160, 4))
+    y = x[:, 0] * 2.0 + x[:, 1]
+    df = DataFrame({"features": x, "label": y})
+    model = LightGBMRegressor(numIterations=3, numLeaves=4,
+                              maxBin=16).set_mesh(mesh8).fit(df)
+    assert model._mesh is mesh8
+    out = model.transform(df)
+    assert np.isfinite(np.asarray(out["prediction"], np.float64)).all()
+
+
+def test_deep_model_sharded_logits_match(mesh8, rng):
+    from mmlspark_tpu.dl import DeepTextClassifier
+
+    texts = np.asarray(["good fine great", "bad poor awful"] * 40,
+                       dtype=object)
+    labels = np.tile([1.0, 0.0], 40)
+    df = DataFrame({"text": texts, "label": labels})
+    model = DeepTextClassifier(batchSize=16, maxEpochs=1, labelCol="label",
+                               maxLength=4, embeddingDim=16, numLayers=1,
+                               numHeads=2, mesh=mesh8).fit(df)
+    assert model._mesh is mesh8  # inherited from the estimator
+    sharded = model.transform(df)
+    model._mesh = None
+    single = model.transform(df)
+    np.testing.assert_allclose(
+        np.asarray(list(single["probability"]), np.float64),
+        np.asarray(list(sharded["probability"]), np.float64),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_sharded_scoring_matches(mesh8, rng):
+    from mmlspark_tpu.onnx.model import ONNXModel
+    from tests.onnx.test_onnx import _mlp_model
+
+    proto, _ = _mlp_model(rng)
+    x = rng.normal(size=(33, 4)).astype(np.float32)
+    df = DataFrame({"features": x})
+    single = ONNXModel(modelPayload=proto, miniBatchSize=16).transform(df)
+    sharded = ONNXModel(modelPayload=proto,
+                        miniBatchSize=16).set_mesh(mesh8).transform(df)
+    np.testing.assert_allclose(
+        np.asarray(list(single["output"]), np.float64),
+        np.asarray(list(sharded["output"]), np.float64),
+        rtol=1e-5, atol=1e-6)
