@@ -2,10 +2,12 @@
 
 Parity: hf/HuggingFaceSentenceEmbedder.py:26-60 — a Transformer that
 maps a text column to an embeddings column via batched device
-inference (their ``predict_batch_udf``). Zero-egress: the encoder is
-either a freshly-initialized in-repo TextTransformer (useful as a
-hashing-based featurizer) or the encoder lifted from a fitted
-:class:`~mmlspark_tpu.dl.text.DeepTextModel` via ``from_text_model``.
+inference (their ``predict_batch_udf``). Weights must come from
+somewhere real: a local ONNX encoder checkpoint (``modelFile``), a
+fitted :class:`~mmlspark_tpu.dl.text.DeepTextModel`
+(``from_text_model``), or — only with the explicit
+``allowRandomEncoder`` opt-in — a freshly-initialized encoder whose
+embeddings carry hashing-trick geometry but no semantics.
 """
 
 from __future__ import annotations
@@ -16,7 +18,7 @@ import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.core.param import (
-    HasInputCol, HasOutputCol, Param, gt, to_int,
+    HasInputCol, HasOutputCol, Param, gt, to_bool, to_int, to_str,
 )
 from mmlspark_tpu.core.pipeline import Transformer
 from mmlspark_tpu.dl.backbones import TextTransformer
@@ -34,10 +36,21 @@ class SentenceEmbedder(Transformer, HasInputCol, HasOutputCol):
     batchSize = Param("batchSize", "inference batch size", to_int, gt(0),
                       default=256)
     seed = Param("seed", "init seed for the fresh encoder", to_int, default=0)
+    modelFile = Param("modelFile", "local ONNX encoder checkpoint; its "
+                      "output is the embedding", to_str)
+    fetchTensor = Param("fetchTensor", "ONNX tensor to use as embedding "
+                        "(default: the graph output)", to_str)
+    allowRandomEncoder = Param(
+        "allowRandomEncoder", "explicitly allow a randomly-initialized "
+        "encoder (embeddings have hashing geometry, NO semantics)",
+        to_bool, default=False)
 
     _module = None
     _params = None
     _apply_jit = None
+    _onnx_run = None
+    _onnx_in = None
+    _onnx_out = None
 
     @staticmethod
     def from_text_model(model, inputCol: str = "text",
@@ -62,7 +75,32 @@ class SentenceEmbedder(Transformer, HasInputCol, HasOutputCol):
         import jax
         import jax.numpy as jnp
 
+        if self.is_set("modelFile"):
+            if self._onnx_run is None:
+                from mmlspark_tpu.onnx.convert import OnnxGraph, load_model
+                with open(self.get("modelFile"), "rb") as f:
+                    payload = f.read()
+                fetch = ([self.get("fetchTensor")]
+                         if self.is_set("fetchTensor") else None)
+                graph = OnnxGraph(load_model(payload), fetch)
+                if len(graph.input_names) != 1:
+                    raise ValueError(
+                        f"SentenceEmbedder supports single-input ONNX "
+                        f"encoders; {self.get('modelFile')} has inputs "
+                        f"{graph.input_names}")
+                self._onnx_run = jax.jit(graph.convert())
+                self._onnx_in = graph.input_names[0]
+                self._onnx_out = graph.output_names[0]
+            return
         if self._module is None:
+            if not self.get("allowRandomEncoder"):
+                raise ValueError(
+                    "SentenceEmbedder has no weights: set modelFile to a "
+                    "local ONNX encoder checkpoint, build it with "
+                    "SentenceEmbedder.from_text_model(fitted_text_model), "
+                    "or opt in to a randomly-initialized encoder with "
+                    "allowRandomEncoder=True (embeddings then carry NO "
+                    "semantics — hashing geometry only)")
             self._module = TextTransformer(
                 num_classes=0, vocab_size=self.get("vocabSize"),
                 dim=self.get("embeddingDim"), heads=self.get("numHeads"),
@@ -79,10 +117,14 @@ class SentenceEmbedder(Transformer, HasInputCol, HasOutputCol):
         ids = hash_tokenize([str(v) for v in
                              dataset.col(self.get("inputCol"))],
                             self.get("maxLength"), self.get("vocabSize"))
-        if self._apply_jit is None:  # cache: avoid per-call retraces
-            self._apply_jit = jax.jit(
-                lambda p, xb: self._module.apply(p, xb))
-        apply = self._apply_jit
+        if self._onnx_run is not None:
+            apply = lambda _p, xb: self._onnx_run(  # noqa: E731
+                {self._onnx_in: xb})[self._onnx_out]
+        else:
+            if self._apply_jit is None:  # cache: avoid per-call retraces
+                self._apply_jit = jax.jit(
+                    lambda p, xb: self._module.apply(p, xb))
+            apply = self._apply_jit
         bs = self.get("batchSize")
         outs = []
         for s in range(0, len(ids), bs):

@@ -208,19 +208,19 @@ class DeepModel(Model, _DeepParams):
         outs = []
         for s in range(0, len(x), batch):
             xb = x[s:s + batch]
+            # pad the tail chunk to the full batch shape so the jitted
+            # forward compiles exactly once
+            pad = 0
+            if len(xb) < batch and len(x) > batch:
+                pad = batch - len(xb)
+                xb = np.concatenate([xb, np.repeat(xb[-1:], pad, axis=0)])
             if self._mesh is not None:
                 from mmlspark_tpu.parallel.inference import sharded_apply
                 o = sharded_apply(lambda b: apply(self._params, b), xb,
                                   self._mesh)
             else:
-                pad = 0
-                if len(xb) < batch and len(x) > batch:
-                    pad = batch - len(xb)
-                    xb = np.concatenate([xb, np.repeat(xb[-1:], pad,
-                                                       axis=0)])
                 o = np.asarray(apply(self._params, jnp.asarray(xb)))
-                o = o[:len(o) - pad] if pad else o
-            outs.append(o)
+            outs.append(o[:len(o) - pad] if pad else o)
         return np.concatenate(outs)
 
     def _transform(self, dataset: DataFrame) -> DataFrame:

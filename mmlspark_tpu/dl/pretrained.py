@@ -1,0 +1,113 @@
+"""Pretrained-weight bridge: ONNX checkpoints as fine-tunable flax
+backbones.
+
+The reference starts DeepVision/DeepText from real torchvision/HF
+checkpoints (dl/DeepVisionClassifier.py:7-31,
+hf/HuggingFaceSentenceEmbedder.py:26-60). In a zero-egress environment
+the local equivalent is an ONNX file: the in-repo importer
+(onnx/convert.py) lifts its floating-point initializers into a
+parameter pytree, and :class:`OnnxBackbone` exposes them as flax params
+*initialized to the checkpoint values* — so the standard mesh-sharded
+train step fine-tunes them (or freezes them with ``stop_gradient`` for
+feature extraction) with no special-casing in the training loop.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import numpy as np
+
+from mmlspark_tpu.core.param import Param, to_bool, to_str
+
+# flax re-runs setup() on every trace; parsing the protobuf and
+# rebuilding the converted graph each time would re-read the whole
+# checkpoint — cache per (payload digest, fetch)
+_GRAPH_CACHE: Dict[Tuple[str, Optional[str]], Any] = {}
+
+
+def _converted(payload: bytes, fetch: Optional[str]):
+    key = (hashlib.sha256(payload).hexdigest(), fetch)
+    if key not in _GRAPH_CACHE:
+        from mmlspark_tpu.onnx.convert import OnnxGraph, load_model
+
+        graph = OnnxGraph(load_model(payload), [fetch] if fetch else None)
+        if len(graph.input_names) != 1:
+            raise ValueError(
+                f"OnnxBackbone supports single-input graphs; this one "
+                f"has inputs {graph.input_names}")
+        fn, weights = graph.convert_trainable()
+        _GRAPH_CACHE[key] = (fn, weights, graph.input_names[0],
+                             graph.output_names[0])
+    return _GRAPH_CACHE[key]
+
+
+class OnnxBackbone(nn.Module):
+    """Imported ONNX graph as a flax module with an optional trainable
+    classification head.
+
+    ``payload``: ONNX model bytes (hashable static). ``fetch``: tensor
+    name to use as the backbone output (default: the graph's first
+    output). ``num_classes > 0`` appends a Dense head over the flattened
+    backbone output; ``freeze`` stops gradients into the imported
+    weights (frozen-feature mode).
+    """
+
+    payload: bytes
+    num_classes: int = 0
+    fetch: Optional[str] = None
+    freeze: bool = False
+
+    def setup(self):
+        fn, weights, inp, out = _converted(self.payload, self.fetch)
+        self._fn = fn
+        self._out = out
+        self._input = inp
+        self._weights = {
+            name: self.param(f"onnx/{name}",
+                             lambda rng, v=v: np.asarray(v))
+            for name, v in weights.items()
+        }
+        if self.num_classes > 0:
+            self._head = nn.Dense(self.num_classes, name="head")
+
+    def __call__(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        w = self._weights
+        if self.freeze:
+            w = jax.lax.stop_gradient(w)
+        out = self._fn(w, {self._input: x})[self._out]
+        if self.num_classes > 0:
+            out = out.reshape(out.shape[0], -1)
+            out = self._head(out)
+        return out
+
+
+def load_backbone_bytes(path_or_bytes: Any) -> bytes:
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        return bytes(path_or_bytes)
+    with open(path_or_bytes, "rb") as f:
+        return f.read()
+
+
+class PretrainedBackboneParams:
+    """Shared estimator/model params for ONNX-checkpoint backbones."""
+
+    backboneFile = Param("backboneFile", "local ONNX checkpoint: its "
+                         "float weights become the (fine-tunable) "
+                         "backbone parameters", to_str)
+    fetchTensor = Param("fetchTensor", "ONNX tensor used as backbone "
+                        "output (default: the graph output)", to_str)
+    freezeBackbone = Param("freezeBackbone", "stop gradients into the "
+                           "imported weights (frozen-feature mode)",
+                           to_bool, default=False)
+
+    def _onnx_module(self, num_classes: int) -> OnnxBackbone:
+        payload = load_backbone_bytes(self.get("backboneFile"))
+        return OnnxBackbone(payload=payload, num_classes=num_classes,
+                            fetch=self.get("fetchTensor"),
+                            freeze=self.get("freezeBackbone"))

@@ -17,6 +17,7 @@ from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.core.param import Param, gt, to_int, to_str
 from mmlspark_tpu.dl.backbones import TextTransformer
 from mmlspark_tpu.dl.estimator import DeepEstimator, DeepModel
+from mmlspark_tpu.dl.pretrained import PretrainedBackboneParams
 from mmlspark_tpu.ops.hashing import murmur3_32
 
 
@@ -31,7 +32,7 @@ def hash_tokenize(texts: List[str], max_len: int, vocab_size: int
     return out
 
 
-class _TextParams:
+class _TextParams(PretrainedBackboneParams):
     maxLength = Param("maxLength", "max tokens per document", to_int, gt(0),
                       default=64)
     vocabSize = Param("vocabSize", "hashed vocabulary size", to_int, gt(1),
@@ -46,6 +47,8 @@ class _TextParams:
 
 class DeepTextClassifier(DeepEstimator, _TextParams):
     def _build_module(self, num_classes: int):
+        if self.is_set("backboneFile"):
+            return self._onnx_module(num_classes)
         return TextTransformer(
             num_classes=num_classes, vocab_size=self.get("vocabSize"),
             dim=self.get("embeddingDim"), heads=self.get("numHeads"),
@@ -73,6 +76,8 @@ class DeepTextModel(DeepModel, _TextParams):
                              self.get("maxLength"), self.get("vocabSize"))
 
     def _rebuild_module(self):
+        if self.is_set("backboneFile"):
+            return self._onnx_module(len(self._classes))
         return TextTransformer(
             num_classes=len(self._classes),
             vocab_size=self.get("vocabSize"), dim=self.get("embeddingDim"),

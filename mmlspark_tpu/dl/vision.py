@@ -17,6 +17,7 @@ from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.core.param import Param, to_str
 from mmlspark_tpu.dl.backbones import VISION_BACKBONES
 from mmlspark_tpu.dl.estimator import DeepEstimator, DeepModel
+from mmlspark_tpu.dl.pretrained import PretrainedBackboneParams
 
 
 def _stack_images(col) -> np.ndarray:
@@ -33,13 +34,15 @@ def _stack_images(col) -> np.ndarray:
     return x
 
 
-class DeepVisionClassifier(DeepEstimator):
+class DeepVisionClassifier(DeepEstimator, PretrainedBackboneParams):
     backbone = Param("backbone", "vision backbone name", to_str,
                      default="simple_cnn")
     imageCol = Param("imageCol", "image column (HWC arrays)", to_str,
                      default="image")
 
     def _build_module(self, num_classes: int):
+        if self.is_set("backboneFile"):
+            return self._onnx_module(num_classes)
         name = self.get("backbone")
         if name not in VISION_BACKBONES:
             raise ValueError(f"unknown backbone {name!r}; "
@@ -60,7 +63,7 @@ class DeepVisionClassifier(DeepEstimator):
         return model
 
 
-class DeepVisionModel(DeepModel):
+class DeepVisionModel(DeepModel, PretrainedBackboneParams):
     backbone = Param("backbone", "vision backbone name", to_str,
                      default="simple_cnn")
     imageCol = Param("imageCol", "image column", to_str, default="image")
@@ -75,6 +78,8 @@ class DeepVisionModel(DeepModel):
 
     def _rebuild_module(self):
         n = len(self._classes)
+        if self.is_set("backboneFile"):
+            return self._onnx_module(n)
         return VISION_BACKBONES[self.get("backbone")](n)
 
     def _dummy_input(self) -> np.ndarray:

@@ -116,11 +116,15 @@ def ndcg_at(k: int):
         dcg_g = samef @ dcg_t
         idcg_g = jnp.maximum(samef @ idcg_t, 1e-12)
         # every row carries its group's NDCG; weight rows by 1/group_size
-        # so each group counts once in the mean
+        # so each group counts once in the mean. Groups whose rows all
+        # have zero weight (e.g. mesh-padding groups) are excluded.
+        w = _w(weights, raw)
+        group_valid = (samef @ (w > 0).astype(raw.dtype)) > 0
         gsize = jnp.sum(samef, axis=1)
         per_row_ndcg = dcg_g / idcg_g
-        num_groups = jnp.sum(1.0 / gsize)
-        return jnp.sum(per_row_ndcg / gsize) / num_groups
+        inc = jnp.where(group_valid, 1.0 / gsize, 0.0)
+        num_groups = jnp.maximum(jnp.sum(inc), 1e-12)
+        return jnp.sum(per_row_ndcg * inc) / num_groups
 
     ndcg.__name__ = f"ndcg@{k}"
     return ndcg

@@ -367,12 +367,13 @@ class OnnxGraph:
                 needed.update(node.input)
         return list(reversed(live))
 
-    def convert(self) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+    def _make_executor(self):
+        """Shared node-execution loop for convert/convert_trainable:
+        (env) -> fetches, where env already holds initializers + feeds."""
         import jax.numpy as jnp
 
         table = _build_op_table()
         nodes = self._nodes
-        inits = self.initializers
         out_names = self.output_names
 
         for node in nodes:
@@ -381,11 +382,7 @@ class OnnxGraph:
                     f"ONNX op {node.op_type!r} not supported by the "
                     f"XLA importer")
 
-        def run(feeds: Dict[str, Any]) -> Dict[str, Any]:
-            env: Dict[str, Any] = {k: jnp.asarray(v)
-                                   for k, v in inits.items()}
-            for k, v in feeds.items():
-                env[k] = jnp.asarray(v)
+        def execute(env: Dict[str, Any]) -> Dict[str, Any]:
             for node in nodes:
                 vals = [env[i] for i in node.input if i]
                 attrs = _attrs(node)
@@ -396,14 +393,58 @@ class OnnxGraph:
                     for name, p in zip(node.output, parts):
                         env[name] = p
                     continue
-                result = table[node.op_type](vals, node, attrs)
-                env[node.output[0]] = result
+                env[node.output[0]] = table[node.op_type](vals, node, attrs)
             missing = [o for o in out_names if o not in env]
             if missing:
                 raise KeyError(f"graph has no tensors {missing}")
             return {o: env[o] for o in out_names}
 
+        return execute
+
+    def convert(self) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+        import jax.numpy as jnp
+
+        execute = self._make_executor()
+        inits = self.initializers
+
+        def run(feeds: Dict[str, Any]) -> Dict[str, Any]:
+            # initializers stay numpy: shape-consuming ops (Reshape) need
+            # concrete values, and int64 -> int32 jnp conversion under a
+            # trace would turn them into tracers
+            env: Dict[str, Any] = dict(inits)
+            for k, v in feeds.items():
+                env[k] = jnp.asarray(v)
+            return execute(env)
+
         return run
+
+    def convert_trainable(self):
+        """(fn, weights): the graph as ``fn(weights, feeds) -> fetches``
+        with the FLOATING-POINT initializers lifted into the ``weights``
+        dict — differentiable, so an imported ONNX checkpoint becomes a
+        fine-tunable parameter pytree (the pretrained-weight bridge the
+        reference gets from torchvision/HF checkpoints,
+        dl/DeepVisionClassifier.py:7-31). Integer initializers (shapes,
+        axes, gather indices) stay baked as static constants.
+        """
+        import jax.numpy as jnp
+
+        execute = self._make_executor()
+        weights = {k: np.asarray(v) for k, v in self.initializers.items()
+                   if np.issubdtype(np.asarray(v).dtype, np.floating)}
+        static = {k: v for k, v in self.initializers.items()
+                  if k not in weights}
+
+        def run(params: Dict[str, Any], feeds: Dict[str, Any]
+                ) -> Dict[str, Any]:
+            # static (non-float) initializers stay numpy — see convert()
+            env: Dict[str, Any] = dict(static)
+            env.update(params)
+            for k, v in feeds.items():
+                env[k] = jnp.asarray(v)
+            return execute(env)
+
+        return run, weights
 
 
 def convert_model(source, outputs: Optional[Sequence[str]] = None

@@ -103,6 +103,7 @@ class TestDeepText:
             assert np.allclose(e[0], e[same[0]], atol=1e-5)
 
         fresh = SentenceEmbedder(inputCol="text", outputCol="embeddings",
+                                 allowRandomEncoder=True,
                                  maxLength=8, embeddingDim=16, numLayers=1,
                                  numHeads=2)
         out2 = fresh.transform(df)

@@ -440,6 +440,7 @@ def build_registry() -> Dict[str, TestObject]:
             tab.head(16), approx=1e-4),
         "SentenceEmbedder": TestObject(
             SentenceEmbedder(inputCol="text", outputCol="emb", maxLength=6,
+                             allowRandomEncoder=True,
                              embeddingDim=16, numLayers=1, numHeads=2),
             tab.head(8), skip_serialization=True),
         # image
