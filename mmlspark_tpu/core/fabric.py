@@ -1,0 +1,105 @@
+"""Fabric telemetry client + token library.
+
+Parity: the reference posts "certified events" to the MS-Fabric
+telemetry endpoint with platform detection and token auth
+(fabric/FabricClient.scala:1, TokenLibrary.scala:1,
+logging/CertifiedEventClient.scala:16-21, PlatformDetails.scala:1).
+Zero-egress redesign: the client is endpoint-agnostic — unset, events
+accumulate in the in-process telemetry sink; set (any reachable URL, or
+a real Fabric host when egress exists), events POST asynchronously with
+token auth and SAS scrubbing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+from mmlspark_tpu.core.logging_utils import SINK, logger, scrub
+
+
+def detect_platform() -> str:
+    """PlatformDetails.scala analog: name the hosting platform from the
+    environment."""
+    if os.environ.get("AZURE_SERVICE") == "Microsoft.ProjectArcadia":
+        return "synapse"
+    if "SYNAPSE_WORKSPACE_NAME" in os.environ:
+        return "synapse_internal"
+    if "DATABRICKS_RUNTIME_VERSION" in os.environ:
+        return "databricks"
+    if os.environ.get("JPY_PARENT_PID") or "COLAB_GPU" in os.environ:
+        return "notebook"
+    return "unknown"
+
+
+class TokenLibrary:
+    """Pluggable auth-token provider (fabric/TokenLibrary.scala:1).
+
+    Resolution order: an explicit provider callable, then the
+    ``MMLSPARK_TPU_FABRIC_TOKEN`` environment variable."""
+
+    ENV_VAR = "MMLSPARK_TPU_FABRIC_TOKEN"
+
+    def __init__(self, provider: Optional[Callable[[], str]] = None):
+        self._provider = provider
+
+    def get_access_token(self) -> Optional[str]:
+        if self._provider is not None:
+            return self._provider()
+        return os.environ.get(self.ENV_VAR)
+
+
+class FabricClient:
+    """Certified-event emitter (CertifiedEventClient.scala:16-21).
+
+    ``emit`` scrubs secrets, stamps platform + schema fields, and either
+    posts to the configured endpoint on a background thread (fire and
+    forget, never blocking the fit/transform path) or records into the
+    process telemetry sink when no endpoint is configured.
+    """
+
+    def __init__(self, endpoint: Optional[str] = None,
+                 tokens: Optional[TokenLibrary] = None,
+                 timeout: float = 5.0):
+        self.endpoint = endpoint or os.environ.get(
+            "MMLSPARK_TPU_FABRIC_ENDPOINT")
+        self.tokens = tokens or TokenLibrary()
+        self.timeout = timeout
+        self._threads: List[threading.Thread] = []
+
+    def emit(self, event: Dict[str, Any]) -> None:
+        record = {"platform": detect_platform(),
+                  "schemaVersion": 1,
+                  **{k: (scrub(v) if isinstance(v, str) else v)
+                     for k, v in event.items()}}
+        if not self.endpoint:
+            SINK.emit({"certifiedEvent": record})
+            return
+        # prune finished posts so long-lived emitters don't accumulate
+        # dead Thread objects
+        self._threads = [t for t in self._threads if t.is_alive()]
+        t = threading.Thread(target=self._post, args=(record,), daemon=True)
+        self._threads.append(t)
+        t.start()
+
+    def _post(self, record: Dict[str, Any]) -> None:
+        try:
+            body = json.dumps(record).encode()
+            req = urllib.request.Request(
+                self.endpoint, data=body,
+                headers={"Content-Type": "application/json"})
+            token = self.tokens.get_access_token()
+            if token:
+                req.add_header("Authorization", f"Bearer {token}")
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+        except Exception as e:  # telemetry must never break the caller
+            logger.debug("certified event post failed: %s", e)
+
+    def flush(self, timeout: float = 10.0) -> None:
+        for t in self._threads:
+            t.join(timeout)
+        self._threads = [t for t in self._threads if t.is_alive()]

@@ -277,6 +277,7 @@ class AccessAnomalyModel(Model, _AccessAnomalyParams):
 
     def _set_state(self, state):
         import json
+        self.__dict__.pop("_dev_emb", None)  # embeddings changed
         self._u_emb = np.asarray(state["u_emb"])
         self._v_emb = np.asarray(state["v_emb"])
         meta = json.loads(state["offsets"])
@@ -290,19 +291,41 @@ class AccessAnomalyModel(Model, _AccessAnomalyParams):
         return table.get(str(tenant))
 
     def _transform(self, dataset: DataFrame) -> DataFrame:
+        import jax.numpy as jnp
+
         df = self.res_indexer.transform(self.user_indexer.transform(dataset))
-        t_col = self.get("tenantCol")
-        scores = np.zeros(df.num_rows)
-        for i in range(df.num_rows):
-            t = df.col(t_col)[i]
-            ui = int(df.col("__u__")[i])
-            ri = int(df.col("__r__")[i])
-            uo, ro = self._off(self._u_off, t), self._off(self._r_off, t)
-            norm = self._norms.get(t, self._norms.get(str(t), (0.0, 1.0)))
-            if not ui or not ri or uo is None or ro is None:
-                scores[i] = 0.0  # unseen user/resource: neutral
-                continue
-            pred = float(self._u_emb[uo + ui] @ self._v_emb[ro + ri])
-            # low affinity => high anomaly
-            scores[i] = (norm[0] - pred) / norm[1]
+        n = df.num_rows
+        if n == 0:
+            return dataset.with_column(self.get("outputCol"), np.zeros(0))
+        ui = np.asarray(df.col("__u__"), np.int64)
+        ri = np.asarray(df.col("__r__"), np.int64)
+        # per-tenant offsets/norms resolved once per tenant group, then
+        # one batched gather + dot on device (the per-row Python loop
+        # this replaces was O(N) interpreter work in the scoring path)
+        uo = np.full(n, -1, np.int64)
+        ro = np.full(n, -1, np.int64)
+        mean = np.zeros(n)
+        std = np.ones(n)
+        groups = DataFrame({"t": df.col(self.get("tenantCol"))}
+                           ).group_indices("t")
+        for t, idx in groups.items():
+            o_u, o_r = self._off(self._u_off, t), self._off(self._r_off, t)
+            if o_u is not None:
+                uo[idx] = o_u
+            if o_r is not None:
+                ro[idx] = o_r
+            nm = self._norms.get(t, self._norms.get(str(t), (0.0, 1.0)))
+            mean[idx], std[idx] = nm[0], nm[1]
+        valid = (ui > 0) & (ri > 0) & (uo >= 0) & (ro >= 0)
+        # embedding tables live on device across calls (serving scores
+        # many small batches; re-uploading them per call would dominate)
+        dev = self.__dict__.setdefault("_dev_emb", {})
+        if "u" not in dev:
+            dev["u"] = jnp.asarray(self._u_emb)
+            dev["v"] = jnp.asarray(self._v_emb)
+        u_rows = dev["u"][np.where(valid, uo + ui, 0)]
+        v_rows = dev["v"][np.where(valid, ro + ri, 0)]
+        dots = np.asarray(jnp.einsum("nd,nd->n", u_rows, v_rows))
+        # low affinity => high anomaly; unseen user/resource: neutral 0
+        scores = np.where(valid, (mean - dots) / std, 0.0)
         return dataset.with_column(self.get("outputCol"), scores)

@@ -95,7 +95,13 @@ def load_backbone_bytes(path_or_bytes: Any) -> bytes:
 
 
 class PretrainedBackboneParams:
-    """Shared estimator/model params for ONNX-checkpoint backbones."""
+    """Shared estimator/model params for ONNX-checkpoint backbones.
+
+    The checkpoint bytes are cached on the stage after first load
+    (``_backbone_payload``) and travel with fitted models through
+    save/load, so a saved model scores anywhere without the original
+    ``backboneFile`` path (same convention as ONNXModel's persisted
+    modelPayload, onnx/model.py)."""
 
     backboneFile = Param("backboneFile", "local ONNX checkpoint: its "
                          "float weights become the (fine-tunable) "
@@ -106,8 +112,17 @@ class PretrainedBackboneParams:
                            "imported weights (frozen-feature mode)",
                            to_bool, default=False)
 
+    _backbone_payload: Optional[bytes] = None
+
+    def _uses_onnx_backbone(self) -> bool:
+        return self._backbone_payload is not None or self.is_set(
+            "backboneFile")
+
     def _onnx_module(self, num_classes: int) -> OnnxBackbone:
-        payload = load_backbone_bytes(self.get("backboneFile"))
-        return OnnxBackbone(payload=payload, num_classes=num_classes,
+        if self._backbone_payload is None:
+            self._backbone_payload = load_backbone_bytes(
+                self.get("backboneFile"))
+        return OnnxBackbone(payload=self._backbone_payload,
+                            num_classes=num_classes,
                             fetch=self.get("fetchTensor"),
                             freeze=self.get("freezeBackbone"))

@@ -47,7 +47,7 @@ class _TextParams(PretrainedBackboneParams):
 
 class DeepTextClassifier(DeepEstimator, _TextParams):
     def _build_module(self, num_classes: int):
-        if self.is_set("backboneFile"):
+        if self._uses_onnx_backbone():
             return self._onnx_module(num_classes)
         return TextTransformer(
             num_classes=num_classes, vocab_size=self.get("vocabSize"),
@@ -66,6 +66,7 @@ class DeepTextClassifier(DeepEstimator, _TextParams):
             **{p.name: v for p, v in self.iter_set_params()
                if DeepTextModel.has_param(p.name)})
         model._init_state(module, params, classes)
+        model._backbone_payload = self._backbone_payload
         return model
 
 
@@ -76,7 +77,7 @@ class DeepTextModel(DeepModel, _TextParams):
                              self.get("maxLength"), self.get("vocabSize"))
 
     def _rebuild_module(self):
-        if self.is_set("backboneFile"):
+        if self._uses_onnx_backbone():
             return self._onnx_module(len(self._classes))
         return TextTransformer(
             num_classes=len(self._classes),
@@ -86,3 +87,16 @@ class DeepTextModel(DeepModel, _TextParams):
 
     def _dummy_input(self) -> np.ndarray:
         return np.zeros((1, self.get("maxLength")), np.int32)
+
+    def _get_state(self):
+        state = super()._get_state()
+        if self._backbone_payload is not None:
+            state["onnx_payload"] = np.frombuffer(self._backbone_payload,
+                                                  dtype=np.uint8)
+        return state
+
+    def _set_state(self, state):
+        if state.get("onnx_payload") is not None:
+            self._backbone_payload = bytes(
+                np.asarray(state["onnx_payload"], np.uint8))
+        super()._set_state(state)

@@ -41,7 +41,7 @@ class DeepVisionClassifier(DeepEstimator, PretrainedBackboneParams):
                      default="image")
 
     def _build_module(self, num_classes: int):
-        if self.is_set("backboneFile"):
+        if self._uses_onnx_backbone():
             return self._onnx_module(num_classes)
         name = self.get("backbone")
         if name not in VISION_BACKBONES:
@@ -60,6 +60,7 @@ class DeepVisionClassifier(DeepEstimator, PretrainedBackboneParams):
                if DeepVisionModel.has_param(p.name)})
         model._init_state(module, params, classes)
         model._input_shape = None
+        model._backbone_payload = self._backbone_payload
         return model
 
 
@@ -78,7 +79,7 @@ class DeepVisionModel(DeepModel, PretrainedBackboneParams):
 
     def _rebuild_module(self):
         n = len(self._classes)
-        if self.is_set("backboneFile"):
+        if self._uses_onnx_backbone():
             return self._onnx_module(n)
         return VISION_BACKBONES[self.get("backbone")](n)
 
@@ -89,8 +90,16 @@ class DeepVisionModel(DeepModel, PretrainedBackboneParams):
     def _get_state(self):
         state = super()._get_state()
         state["input_shape"] = np.asarray(self._input_shape or (16, 16, 3))
+        if self._backbone_payload is not None:
+            # the checkpoint travels with the model: a saved model must
+            # score without the original backboneFile path
+            state["onnx_payload"] = np.frombuffer(self._backbone_payload,
+                                                  dtype=np.uint8)
         return state
 
     def _set_state(self, state):
         self._input_shape = tuple(int(v) for v in state["input_shape"])
+        if state.get("onnx_payload") is not None:
+            self._backbone_payload = bytes(
+                np.asarray(state["onnx_payload"], np.uint8))
         super()._set_state(state)

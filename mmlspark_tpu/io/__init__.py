@@ -12,15 +12,49 @@ from mmlspark_tpu.io.http import (
     HTTPTransformer,
     SimpleHTTPTransformer,
 )
-from mmlspark_tpu.io.serving import ServingServer, serve_pipeline
+from mmlspark_tpu.io.serving import (
+    ContinuousServingServer,
+    ServingFleet,
+    ServingServer,
+    serve_continuous,
+    serve_distributed,
+    serve_pipeline,
+)
 from mmlspark_tpu.io.cognitive import (
     CognitiveServiceTransformer,
     OpenAIChatCompletion,
     OpenAIEmbedding,
     OpenAIPrompt,
 )
+from mmlspark_tpu.io.cognitive_services import (
+    OCR,
+    AnalyzeImage,
+    DescribeImage,
+    DetectAnomalies,
+    DetectFace,
+    DetectLastAnomaly,
+    EntityRecognizer,
+    KeyPhraseExtractor,
+    LanguageDetector,
+    PIIRecognizer,
+    TextSentiment,
+    Translate,
+)
+from mmlspark_tpu.io.binary import (
+    PowerBIWriter,
+    read_binary_files,
+    read_image_files,
+    write_to_power_bi,
+)
 
 __all__ = ["HTTPTransformer", "SimpleHTTPTransformer", "HTTPResponseData",
-           "ServingServer", "serve_pipeline",
+           "ServingServer", "ServingFleet", "ContinuousServingServer",
+           "serve_pipeline", "serve_distributed", "serve_continuous",
            "CognitiveServiceTransformer", "OpenAIChatCompletion",
-           "OpenAIEmbedding", "OpenAIPrompt"]
+           "OpenAIEmbedding", "OpenAIPrompt",
+           "TextSentiment", "KeyPhraseExtractor", "LanguageDetector",
+           "EntityRecognizer", "PIIRecognizer", "Translate",
+           "DetectLastAnomaly", "DetectAnomalies", "AnalyzeImage",
+           "DescribeImage", "OCR", "DetectFace",
+           "PowerBIWriter", "read_binary_files", "read_image_files",
+           "write_to_power_bi"]

@@ -1,0 +1,215 @@
+"""Cognitive-service families beyond OpenAI.
+
+Parity: the reference's ~13 HTTP service families built on
+CognitiveServicesBase (services/CognitiveServiceBase.scala:491) — text
+analytics (text/TextAnalytics.scala:1), translation
+(translate/Translate.scala), anomaly detection
+(anomaly/MultivariateAnomalyDetection.scala:1 — the univariate API),
+vision (vision/ComputerVision.scala:1) and face (face/Face.scala).
+Request/response wire formats match the public Azure APIs, so the same
+transformers work against real services when egress exists; tests run
+them against canned local servers.
+
+Speech (binary audio streaming) and the async form-recognizer protocol
+are intentionally out of scope for this layer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from mmlspark_tpu.core.param import Param, to_bool, to_str
+from mmlspark_tpu.io.cognitive import CognitiveServiceTransformer
+
+
+# ---------------------------------------------------------------------------
+# Text analytics family (text/TextAnalytics.scala)
+# ---------------------------------------------------------------------------
+
+class _TextAnalyticsBase(CognitiveServiceTransformer):
+    """documents=[{id, text, language}] request shape shared by the
+    whole family."""
+
+    textCol = Param("textCol", "text column", to_str, default="text")
+    language = Param("language", "document language hint", to_str,
+                     default="en")
+
+    def _build_body(self, row):
+        return {"documents": [{"id": "0",
+                               "text": str(row[self.get("textCol")]),
+                               "language": self.get("language")}]}
+
+    def _doc(self, response):
+        try:
+            return response["documents"][0]
+        except (KeyError, IndexError, TypeError):
+            return None
+
+
+class TextSentiment(_TextAnalyticsBase):
+    """sentiment label + confidence scores per document."""
+
+    def _parse(self, response):
+        doc = self._doc(response)
+        if doc is None:
+            return response
+        return {"sentiment": doc.get("sentiment"),
+                "scores": doc.get("confidenceScores", {})}
+
+
+class KeyPhraseExtractor(_TextAnalyticsBase):
+    def _parse(self, response):
+        doc = self._doc(response)
+        return response if doc is None else list(doc.get("keyPhrases", []))
+
+
+class LanguageDetector(_TextAnalyticsBase):
+    def _build_body(self, row):
+        # language detection sends no language hint
+        return {"documents": [{"id": "0",
+                               "text": str(row[self.get("textCol")])}]}
+
+    def _parse(self, response):
+        doc = self._doc(response)
+        if doc is None:
+            return response
+        detected = doc.get("detectedLanguage", {})
+        return {"name": detected.get("name"),
+                "iso6391Name": detected.get("iso6391Name"),
+                "confidenceScore": detected.get("confidenceScore")}
+
+
+class EntityRecognizer(_TextAnalyticsBase):
+    def _parse(self, response):
+        doc = self._doc(response)
+        return response if doc is None else list(doc.get("entities", []))
+
+
+class PIIRecognizer(_TextAnalyticsBase):
+    def _parse(self, response):
+        doc = self._doc(response)
+        if doc is None:
+            return response
+        return {"redactedText": doc.get("redactedText"),
+                "entities": list(doc.get("entities", []))}
+
+
+# ---------------------------------------------------------------------------
+# Translation (translate/Translate.scala)
+# ---------------------------------------------------------------------------
+
+class Translate(CognitiveServiceTransformer):
+    """POST [{'text': ...}]; the target language rides in the url's
+    ``to=`` query (the reference appends it the same way)."""
+
+    textCol = Param("textCol", "text column", to_str, default="text")
+
+    def _build_body(self, row):
+        return [{"text": str(row[self.get("textCol")])}]
+
+    def _parse(self, response):
+        try:
+            return [t["text"] for t in response[0]["translations"]]
+        except (KeyError, IndexError, TypeError):
+            return response
+
+
+# ---------------------------------------------------------------------------
+# Anomaly detection (anomaly family, univariate API)
+# ---------------------------------------------------------------------------
+
+class _AnomalyBase(CognitiveServiceTransformer):
+    """seriesCol holds [{'timestamp','value'}...] lists."""
+
+    seriesCol = Param("seriesCol", "time-series column of "
+                      "{timestamp, value} dicts", to_str, default="series")
+    granularity = Param("granularity", "series granularity", to_str,
+                        default="daily")
+
+    def _build_body(self, row):
+        return {"series": list(row[self.get("seriesCol")]),
+                "granularity": self.get("granularity")}
+
+
+class DetectLastAnomaly(_AnomalyBase):
+    def _parse(self, response):
+        if not isinstance(response, dict) or "isAnomaly" not in response:
+            return response
+        return {"isAnomaly": bool(response["isAnomaly"]),
+                "expectedValue": response.get("expectedValue"),
+                "upperMargin": response.get("upperMargin"),
+                "lowerMargin": response.get("lowerMargin")}
+
+
+class DetectAnomalies(_AnomalyBase):
+    def _parse(self, response):
+        if not isinstance(response, dict) or "isAnomaly" not in response:
+            return response
+        return {"isAnomaly": list(response["isAnomaly"]),
+                "expectedValues": list(response.get("expectedValues", []))}
+
+
+# ---------------------------------------------------------------------------
+# Vision + face (vision/ComputerVision.scala, face/Face.scala)
+# ---------------------------------------------------------------------------
+
+class _ImageUrlBase(CognitiveServiceTransformer):
+    imageUrlCol = Param("imageUrlCol", "image url column", to_str,
+                        default="url")
+
+    def _build_body(self, row):
+        return {"url": str(row[self.get("imageUrlCol")])}
+
+
+class AnalyzeImage(_ImageUrlBase):
+    def _parse(self, response):
+        if not isinstance(response, dict):
+            return response
+        out: Dict[str, Any] = {}
+        if "categories" in response:
+            out["categories"] = [c.get("name")
+                                 for c in response["categories"]]
+        if "tags" in response:
+            out["tags"] = [t.get("name") for t in response["tags"]]
+        if "description" in response:
+            caps = response["description"].get("captions", [])
+            out["captions"] = [c.get("text") for c in caps]
+        return out or response
+
+
+class DescribeImage(_ImageUrlBase):
+    def _parse(self, response):
+        try:
+            caps = response["description"]["captions"]
+            return [c["text"] for c in caps]
+        except (KeyError, IndexError, TypeError):
+            return response
+
+
+class OCR(_ImageUrlBase):
+    def _parse(self, response):
+        try:
+            words: List[str] = []
+            for region in response["regions"]:
+                for line in region["lines"]:
+                    words.extend(w["text"] for w in line["words"])
+            return " ".join(words)
+        except (KeyError, TypeError):
+            return response
+
+
+class DetectFace(_ImageUrlBase):
+    returnFaceAttributes = Param("returnFaceAttributes",
+                                 "include face attributes", to_bool,
+                                 default=False)
+
+    def _parse(self, response):
+        if not isinstance(response, list):
+            return response
+        return [{"faceId": f.get("faceId"),
+                 "faceRectangle": f.get("faceRectangle"),
+                 **({"faceAttributes": f.get("faceAttributes")}
+                    if self.get("returnFaceAttributes") else {})}
+                for f in response]

@@ -1,17 +1,25 @@
 """Model serving: HTTP requests -> device batches -> replies.
 
-Parity: Spark Serving (SURVEY.md §3.5) — head-node mode
-(HTTPSource.scala:42 + HTTPSink.scala:177: one server, requests become
-micro-batch rows, replies matched by request id) and the continuous
-sub-ms path (HTTPSourceV2.scala:305). The distributed per-executor mode
-(DistributedHTTPSource.scala:203) maps to one ServingServer per host in
-a pod; on one host it is the same object.
+Parity: Spark Serving (SURVEY.md §3.5), all three modes:
+
+  - **head-node** (HTTPSource.scala:42 + HTTPSink.scala:177):
+    :class:`ServingServer` — one server, requests become micro-batch
+    rows, replies matched by request id;
+  - **distributed** (DistributedHTTPSource.scala:203,362 + the driver
+    service registry, HTTPSourceV2.scala:132-193):
+    :class:`ServingFleet` — N worker servers (per host in a pod) plus a
+    registry endpoint listing them; clients send to any worker, exactly
+    like requests entering at executor listeners;
+  - **continuous** (continuous/HTTPSourceV2.scala:305):
+    :class:`ContinuousServingServer` — per-request synchronous scoring
+    with a pre-warmed compiled scorer, no micro-batch wait (the ~ms
+    budget in BASELINE.md).
 
 TPU-first design: requests are accumulated into micro-batches
 (``maxBatchSize`` rows or ``maxLatencyMs``) and scored as ONE device
 batch — the request/reply correlation the reference keeps in
 HTTPSourceStateHolder (HTTPSourceV2.scala:343) is a local dict of
-request-id -> Event.
+request-id -> Event; client-supplied ``"id"`` fields are echoed back.
 """
 
 from __future__ import annotations
@@ -73,9 +81,7 @@ class ServingServer:
                     self.send_error(400, f"bad json: {e}")
                     return
                 pending = _Pending(payload)
-                with server._lock:
-                    server._queue.append(pending)
-                    server._lock.notify()
+                server._enqueue(pending)
                 if not pending.event.wait(timeout=30.0):
                     self.send_error(504, "scoring timed out")
                     return
@@ -95,6 +101,11 @@ class ServingServer:
             target=self._httpd.serve_forever, daemon=True)
         self._batch_thread = threading.Thread(
             target=self._batch_loop, daemon=True)
+
+    def _enqueue(self, pending: "_Pending") -> None:
+        with self._lock:
+            self._queue.append(pending)
+            self._lock.notify()
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ServingServer":
@@ -144,6 +155,7 @@ class ServingServer:
                     p.event.set()
 
     def _score(self, batch: List[_Pending]):
+        ids = [p.payload.pop("id", None) for p in batch]
         df = DataFrame.from_rows([p.payload for p in batch])
         out = self.model.transform(df)
         reply_cols = [self.reply_col] if self.reply_col else \
@@ -157,10 +169,131 @@ class ServingServer:
                 elif isinstance(v, np.generic):
                     v = v.item()
                 reply[c] = v
+            if ids[i] is not None:  # request-id correlation for clients
+                reply["id"] = ids[i]
             p.reply = reply
             p.event.set()
+
+
+class ContinuousServingServer(ServingServer):
+    """Low-latency mode: each request is scored synchronously on arrival
+    (no micro-batch wait) by a scorer pre-warmed at startup — the
+    continuous-epoch analog (continuous/HTTPSourceV2.scala:305, the ~ms
+    path in BASELINE.md). Throughput trades for latency; use
+    :class:`ServingFleet` of these for both.
+    """
+
+    def __init__(self, model: Transformer, warmup_payload: Optional[dict] = None,
+                 **kwargs):
+        kwargs.setdefault("max_batch_size", 1)
+        super().__init__(model, **kwargs)
+        self._score_lock = threading.Lock()
+        self._warmup_payload = warmup_payload
+
+    def start(self) -> "ContinuousServingServer":
+        if self._warmup_payload is not None:
+            # compile the batch-1 scoring graph before the first request
+            p = _Pending(dict(self._warmup_payload))
+            self._score([p])
+        self._server_thread.start()  # no batch thread: scoring is inline
+        logger.info("continuous serving on %s:%s%s", self.host, self.port,
+                    self.api_path)
+        return self
+
+    def stop(self) -> None:
+        self._stop = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def _enqueue(self, pending: "_Pending") -> None:
+        try:
+            with self._score_lock:
+                self._score([pending])
+        except Exception as e:
+            pending.error = str(e)
+            pending.event.set()
+
+
+class ServingFleet:
+    """Distributed serving: N worker servers + a registry endpoint.
+
+    The reference runs a WorkerServer per executor JVM with a driver
+    service registry (DistributedHTTPSource.scala:203,
+    HTTPSourceV2.scala:132-193 DriverServiceUtils); here each worker is
+    a :class:`ServingServer` (one per host in a pod), and the registry
+    is an HTTP endpoint returning every worker's address so clients can
+    spray requests — requests enter AT the workers, never proxied.
+    """
+
+    def __init__(self, model: Transformer, num_servers: int = 2,
+                 continuous: bool = False, host: str = "127.0.0.1",
+                 **server_kwargs):
+        cls = ContinuousServingServer if continuous else ServingServer
+        self.servers = [cls(model, host=host, port=0, **server_kwargs)
+                        for _ in range(num_servers)]
+        fleet = self
+
+        class RegistryHandler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                if self.path != "/registry":
+                    self.send_error(404)
+                    return
+                body = json.dumps({
+                    "workers": [s.url for s in fleet.servers]}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._registry = ThreadingHTTPServer((host, 0), RegistryHandler)
+        self.registry_host, self.registry_port = self._registry.server_address
+        self._registry_thread = threading.Thread(
+            target=self._registry.serve_forever, daemon=True)
+
+    @property
+    def registry_url(self) -> str:
+        return f"http://{self.registry_host}:{self.registry_port}/registry"
+
+    @property
+    def worker_urls(self) -> List[str]:
+        return [s.url for s in self.servers]
+
+    def start(self) -> "ServingFleet":
+        for s in self.servers:
+            s.start()
+        self._registry_thread.start()
+        logger.info("serving fleet: %d workers, registry %s",
+                    len(self.servers), self.registry_url)
+        return self
+
+    def stop(self) -> None:
+        for s in self.servers:
+            s.stop()
+        self._registry.shutdown()
+        self._registry.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
 
 
 def serve_pipeline(model: Transformer, **kwargs) -> ServingServer:
     """spark.readStream.server() analog: start serving a fitted model."""
     return ServingServer(model, **kwargs).start()
+
+
+def serve_distributed(model: Transformer, num_servers: int = 2,
+                      **kwargs) -> ServingFleet:
+    """spark.readStream.distributedServer() analog."""
+    return ServingFleet(model, num_servers=num_servers, **kwargs).start()
+
+
+def serve_continuous(model: Transformer, **kwargs) -> ContinuousServingServer:
+    """spark.readStream.continuousServer() analog."""
+    return ContinuousServingServer(model, **kwargs).start()

@@ -312,6 +312,15 @@ class _LightGBMModelBase(Model, _LightGBMParams):
     def get_feature_importances(self, importance_type: str = "split") -> np.ndarray:
         return self.booster.feature_importances(importance_type)
 
+    def get_all_instrumentation(self) -> Dict[str, float]:
+        """Per-phase training wall-clock seconds (getAllBatchMeasures
+        analog, LightGBMPerformance.scala:11-66 — the reference returns
+        TaskInstrumentationMeasures to the driver; here the fit measures
+        ride on the fitted model)."""
+        if self.train_measures is None:
+            return {}
+        return self.train_measures.as_dict()
+
     def save_native_model(self, path: str) -> None:
         with open(path, "w") as f:
             f.write(self.booster.save_model_string())

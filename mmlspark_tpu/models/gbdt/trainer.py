@@ -760,21 +760,31 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
                 row_valid = np.concatenate(
                     [np.ones(n, np.float32), np.zeros(pad_n, np.float32)])
                 n = n + pad_n
+        # binned rows stream to device in async chunks at the narrowest
+        # bin dtype (the StreamingPartitionTask micro-batch push analog);
+        # uint8 widens for free in downstream gathers/index math
+        from mmlspark_tpu.ops.ingest import (binned_ingest_dtype,
+                                             chunked_device_put)
+        ing_dtype = binned_ingest_dtype(total_bins)
         if feature_mode:
             # feature_parallel: rows replicated, features sharded on fp
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from mmlspark_tpu.parallel.mesh import FEATURE_AXIS
             dev_put = lambda a, nd=1: jax.device_put(a, replicated(mesh))  # noqa: E731
-            binned_d = jax.device_put(
-                np.ascontiguousarray(binned, dtype=np.int32),
-                NamedSharding(mesh, P(None, FEATURE_AXIS)))
+            binned_d = chunked_device_put(
+                binned, NamedSharding(mesh, P(None, FEATURE_AXIS)),
+                dtype=ing_dtype)
         else:
             dev_put = (lambda a, nd=1: jax.device_put(
                 a, row_sharded(mesh, nd)) if mesh is not None
                 else jnp.asarray(a))
-            binned_d = dev_put(np.ascontiguousarray(binned, dtype=np.int32),
-                               2)
+            from mmlspark_tpu.parallel.mesh import axis_size as _axis_size
+            binned_d = chunked_device_put(
+                binned, row_sharded(mesh, 2) if mesh is not None else None,
+                dtype=ing_dtype,
+                row_multiple=_axis_size(mesh, "dp") if mesh is not None
+                else 1)
         labels_d = dev_put(np.asarray(labels, dtype=np.float32))
         weights_d = None if weights is None else dev_put(
             np.asarray(weights, dtype=np.float32))

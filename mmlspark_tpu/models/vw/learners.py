@@ -141,6 +141,15 @@ class _VWParams(HasLabelCol, HasWeightCol, HasPredictionCol):
                       "example-wise VW semantics)", to_int, ge(1), default=16)
     interPassSync = Param("interPassSync", "average weights across the dp "
                           "mesh axis at pass boundaries", to_bool, default=True)
+    syncScheduleRows = Param(
+        "syncScheduleRows", "also sync within a pass after every N rows "
+        "processed globally (0 = pass boundaries only) — the row-count "
+        "sync schedule, VowpalWabbitSyncSchedule.scala:15-72", to_int,
+        ge(0), default=0)
+    shufflePerPass = Param("shufflePerPass", "reshuffle batch order between "
+                           "passes (seeded; VW replays its cache in order, "
+                           "so default off for parity)", to_bool,
+                           default=False)
     seed = Param("seed", "seed", to_int, default=0)
     passThroughArgs = Param("passThroughArgs", "VW-style argument string; "
                             "recognized flags are mapped onto params "
@@ -262,19 +271,72 @@ class _VWBaseLearner(Estimator, _VWParams):
         bias = jnp.zeros(())
         t = jnp.ones(()) * 0.0
         all_preds = []
-        for p in range(get("numPasses")):
-            w, g2, bias, t, preds = run_pass(w, g2, bias, t,
-                                             jnp.asarray(bidx), jnp.asarray(bval),
-                                             jnp.asarray(by), jnp.asarray(bwt))
-            if progressive and p == 0:
-                all_preds = np.asarray(preds).reshape(-1)[:len(y)]
+        nb_total = bidx.shape[0]
+        ndev = 1
+        if mesh is not None and self.get("interPassSync"):
+            from mmlspark_tpu.parallel.mesh import DATA_AXIS, axis_size
+            ndev = axis_size(mesh, DATA_AXIS)
+        # within-pass sync schedule: each run_pass call ends in a weight
+        # average, so slicing the batch stream into segments of
+        # ~syncScheduleRows rows reproduces the row-count schedule
+        sync_rows = get("syncScheduleRows")
+        if sync_rows and ndev > 1:
+            seg = max(round(sync_rows / get("batchSize") / ndev), 1) * ndev
+        else:
+            seg = nb_total
+        rng_order = np.random.default_rng(get("seed"))
+        from mmlspark_tpu.core.timer import StopWatch
+        watch = StopWatch()
+        pass_losses: List[float] = []
+        with watch.measure():
+            for p in range(get("numPasses")):
+                if p > 0 and self.get("shufflePerPass"):
+                    order = rng_order.permutation(nb_total)
+                    bidx, bval = bidx[order], bval[order]
+                    by, bwt = by[order], bwt[order]
+                preds_parts = []
+                for s in range(0, nb_total, seg):
+                    w, g2, bias, t, preds = run_pass(
+                        w, g2, bias, t,
+                        jnp.asarray(bidx[s:s + seg]),
+                        jnp.asarray(bval[s:s + seg]),
+                        jnp.asarray(by[s:s + seg]),
+                        jnp.asarray(bwt[s:s + seg]))
+                    if progressive and p == 0:
+                        preds_parts.append(np.asarray(preds).reshape(-1))
+                if progressive and p == 0:
+                    all_preds = np.concatenate(preds_parts)[:len(y)]
+                pass_losses.append(self._train_loss(
+                    np.asarray(w), float(bias), idx, val, y, wt))
         state = {
             "weights": np.asarray(w),
             "g2": np.asarray(g2),
             "bias": float(bias),
             "loss": self._loss,
+            "stats": {
+                "numExamples": int(len(y)),
+                "numPasses": int(get("numPasses")),
+                "avgTrainLossPerPass": pass_losses,
+                "trainSeconds": watch.elapsed,
+                "syncsPerPass": int((nb_total + seg - 1) // seg),
+            },
         }
         return state, (np.asarray(all_preds) if progressive else None)
+
+    def _train_loss(self, w, bias, idx, val, y, wt) -> float:
+        """Weighted mean training loss under the current weights (the
+        per-partition loss in TrainingStats,
+        VowpalWabbitBaseLearner.scala:20-59)."""
+        margin = (w[idx.astype(np.int64)] * val).sum(axis=1) + bias
+        if self._loss == "logistic":
+            yy = np.where(y > 0, 1.0, -1.0)
+            per = np.log1p(np.exp(-yy * margin))
+        elif self._loss == "quantile":
+            d = y - margin
+            per = np.maximum(0.5 * d, -0.5 * d)
+        else:
+            per = (margin - y) ** 2
+        return float((per * wt).sum() / max(wt.sum(), 1e-12))
 
     def _make_model(self, model_cls, state):
         model = model_cls(**{k: v for k, v in self._paramMap.items()
@@ -282,6 +344,7 @@ class _VWBaseLearner(Estimator, _VWParams):
         model.weights = state["weights"]
         model.bias = state["bias"]
         model.loss = state["loss"]
+        model.train_stats = state.get("stats")
         return model
 
 
@@ -289,6 +352,7 @@ class _VWBaseModel(Model, _VWParams):
     weights: Optional[np.ndarray] = None
     bias: float = 0.0
     loss: str = "squared"
+    train_stats: Optional[Dict[str, Any]] = None
 
     rawPredictionCol = Param("rawPredictionCol", "margin column", to_str,
                              default="rawPrediction")
@@ -311,9 +375,14 @@ class _VWBaseModel(Model, _VWParams):
         return x @ self.weights[:x.shape[1]] + self.bias
 
     def get_performance_statistics(self) -> Dict[str, Any]:
-        """TrainingStats analog (VowpalWabbitBaseLearner.scala:20-59)."""
-        return {"numWeights": int((np.abs(self.weights) > 0).sum()),
-                "bias": self.bias, "loss": self.loss}
+        """TrainingStats analog (VowpalWabbitBaseLearner.scala:20-59):
+        loss name + weights + per-pass training loss, example counts,
+        sync cadence and wall clock from the fit."""
+        out = {"numWeights": int((np.abs(self.weights) > 0).sum()),
+               "bias": self.bias, "loss": self.loss}
+        if self.train_stats:
+            out.update(self.train_stats)
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -194,16 +194,97 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
 
 
 class ONNXHub:
-    """Model-zoo stub (onnx/ONNXHub.scala:72-99). The environment has no
-    egress; models must be local files."""
+    """Local model zoo with a JSON manifest + checksum verification.
 
-    def __init__(self, cache_dir: Optional[str] = None):
-        self.cache_dir = cache_dir
+    The reference hub (onnx/ONNXHub.scala:72-99) fetches a manifest of
+    models and caches verified downloads. Zero-egress redesign: the hub
+    root is a local directory holding ``manifest.json`` — entries of
+    ``{"model": name, "model_path": relpath, "model_sha256": hex,
+    "tags": [...]}`` — and the model files; ``get_model`` verifies the
+    checksum and memoizes bytes, ``register_model`` builds the manifest.
+    """
 
-    def list_models(self):
-        raise RuntimeError(
-            "ONNXHub requires network access, which this deployment "
-            "disables; load models from local files via "
-            "ONNXModel().set_model_location(path)")
+    MANIFEST = "manifest.json"
 
-    load_model = list_models
+    def __init__(self, hub_dir: str):
+        import os
+        self.hub_dir = hub_dir
+        os.makedirs(hub_dir, exist_ok=True)
+        self._cache: Dict[str, bytes] = {}
+
+    def _manifest_path(self) -> str:
+        import os
+        return os.path.join(self.hub_dir, self.MANIFEST)
+
+    def _read_manifest(self) -> List[Dict[str, Any]]:
+        import json
+        import os
+        if not os.path.exists(self._manifest_path()):
+            return []
+        with open(self._manifest_path()) as f:
+            return json.load(f)
+
+    def list_models(self, tags: Optional[List[str]] = None
+                    ) -> List[Dict[str, Any]]:
+        """Manifest entries, optionally filtered to those carrying ALL
+        the given tags (ONNXHub.listModels parity)."""
+        entries = self._read_manifest()
+        if tags:
+            want = set(tags)
+            entries = [e for e in entries
+                       if want.issubset(set(e.get("tags", [])))]
+        return entries
+
+    def get_model_info(self, name: str) -> Dict[str, Any]:
+        for e in self._read_manifest():
+            if e["model"] == name:
+                return e
+        known = [e["model"] for e in self._read_manifest()]
+        raise KeyError(f"model {name!r} not in hub manifest; have {known}")
+
+    def get_model(self, name: str) -> bytes:
+        """Model bytes, checksum-verified and cached in memory."""
+        import hashlib
+        import os
+        if name in self._cache:
+            return self._cache[name]
+        info = self.get_model_info(name)
+        path = os.path.join(self.hub_dir, info["model_path"])
+        with open(path, "rb") as f:
+            data = f.read()
+        digest = hashlib.sha256(data).hexdigest()
+        if info.get("model_sha256") and digest != info["model_sha256"]:
+            raise ValueError(
+                f"checksum mismatch for {name!r}: manifest "
+                f"{info['model_sha256'][:12]}..., file {digest[:12]}...")
+        self._cache[name] = data
+        return data
+
+    def register_model(self, name: str, payload: bytes,
+                       tags: Optional[List[str]] = None) -> Dict[str, Any]:
+        """Add a model file + manifest entry (builds local zoos)."""
+        import hashlib
+        import json
+        import os
+        import re
+        if not re.fullmatch(r"[A-Za-z0-9._-]+", name) or ".." in name:
+            raise ValueError(
+                f"model name {name!r} must be a plain identifier "
+                f"(letters, digits, . _ -); path separators would escape "
+                f"the hub directory")
+        rel = f"{name}.onnx"
+        with open(os.path.join(self.hub_dir, rel), "wb") as f:
+            f.write(payload)
+        entry = {"model": name, "model_path": rel,
+                 "model_sha256": hashlib.sha256(payload).hexdigest(),
+                 "tags": list(tags or [])}
+        entries = [e for e in self._read_manifest() if e["model"] != name]
+        entries.append(entry)
+        with open(self._manifest_path(), "w") as f:
+            json.dump(entries, f, indent=1)
+        self._cache.pop(name, None)
+        return entry
+
+    def load_model(self, name: str) -> "ONNXModel":
+        """ONNXModel ready to transform (getModel -> scorer parity)."""
+        return ONNXModel(modelPayload=self.get_model(name))

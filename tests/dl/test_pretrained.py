@@ -86,12 +86,15 @@ def test_finetune_from_pretrained_beats_scratch(rng):
     assert acc_pre > 0.95
     assert acc_pre >= acc_scratch
 
-    # persistence: save/load preserves the onnx-backed module
+    # persistence: the checkpoint travels WITH the saved model — delete
+    # the original file before loading to prove no path dependence
     pre.save("/tmp/pre_model_stage")
+    want = np.asarray(list(pre.transform(df)["probability"]), np.float64)
+    os.remove(path)
     from mmlspark_tpu.core.pipeline import PipelineStage
     loaded = PipelineStage.load("/tmp/pre_model_stage")
     np.testing.assert_allclose(
-        np.asarray(list(pre.transform(df)["probability"]), np.float64),
+        want,
         np.asarray(list(loaded.transform(df)["probability"]), np.float64),
         rtol=1e-5, atol=1e-6)
 

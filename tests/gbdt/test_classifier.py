@@ -224,3 +224,20 @@ def test_callbacks_called_live_per_iteration():
     train(mapper.transform(x), y, cfg,
           callbacks=[lambda it, rec: seen.append((it, rec["iteration"]))])
     assert seen == [(i, i) for i in range(5)]
+
+
+def test_instrumentation_surfaces_from_fitted_model(rng):
+    """Users can read per-phase fit timings off the model
+    (LightGBMPerformance.scala:11-66 analog; VERDICT r2 weak #10)."""
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+    x = rng.normal(size=(300, 4))
+    y = (x[:, 0] > 0).astype(np.float64)
+    model = LightGBMClassifier(numIterations=3, numLeaves=4,
+                               maxBin=16).fit(
+        DataFrame({"features": x, "label": y}))
+    measures = model.get_all_instrumentation()
+    assert measures.get("binning", 0) > 0
+    assert measures.get("training", 0) > 0
+    assert model.train_measures.count("training") >= 3

@@ -196,3 +196,105 @@ class TestServing:
             with pytest.raises(urllib.error.HTTPError) as e:
                 urllib.request.urlopen(req, timeout=10)
             assert e.value.code == 500
+
+
+class TestDistributedServing:
+    """Per-host distributed mode + continuous low-latency mode
+    (VERDICT r2 #8; ref DistributedHTTPSource.scala:203,362,
+    continuous/HTTPSourceV2.scala:305)."""
+
+    @staticmethod
+    def _call(url, payload):
+        import urllib.request
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return json.loads(r.read())
+
+    def test_fleet_registry_and_load(self):
+        import urllib.request
+        from concurrent.futures import ThreadPoolExecutor
+
+        from mmlspark_tpu.io.serving import ServingFleet
+
+        with ServingFleet(_DoubleModel(), num_servers=3,
+                          max_latency_ms=5) as fleet:
+            # registry lists every worker (driver service registry analog)
+            with urllib.request.urlopen(fleet.registry_url, timeout=5) as r:
+                workers = json.loads(r.read())["workers"]
+            assert sorted(workers) == sorted(fleet.worker_urls)
+            assert len(set(workers)) == 3
+
+            # structured load sprayed across workers, ids correlated
+            def call_one(i):
+                url = workers[i % len(workers)]
+                out = self._call(url, {"x": float(i), "id": f"req-{i}"})
+                return i, out
+
+            with ThreadPoolExecutor(max_workers=12) as ex:
+                results = list(ex.map(call_one, range(48)))
+            for i, out in results:
+                assert out["doubled"] == 2.0 * i
+                assert out["id"] == f"req-{i}"
+
+    def test_continuous_latency_budget(self):
+        import time
+
+        from mmlspark_tpu.io.serving import ContinuousServingServer
+
+        server = ContinuousServingServer(
+            _DoubleModel(), warmup_payload={"x": 0.0}).start()
+        try:
+            lat = []
+            for i in range(30):
+                t0 = time.perf_counter()
+                out = self._call(server.url, {"x": float(i)})
+                lat.append(time.perf_counter() - t0)
+                assert out["doubled"] == 2.0 * i
+            lat.sort()
+            p50 = lat[len(lat) // 2]
+            # reference continuous mode cites ~1 ms on a cluster
+            # (BASELINE.md); hold a CI-safe bound well under the
+            # micro-batch path's max_latency_ms floor
+            assert p50 < 0.05, f"p50 latency {p50*1e3:.1f} ms"
+        finally:
+            server.stop()
+
+    def test_continuous_fleet(self):
+        from mmlspark_tpu.io.serving import ServingFleet
+
+        with ServingFleet(_DoubleModel(), num_servers=2,
+                          continuous=True) as fleet:
+            for j, url in enumerate(fleet.worker_urls):
+                out = self._call(url, {"x": float(j), "id": str(j)})
+                assert out["doubled"] == 2.0 * j and out["id"] == str(j)
+
+    def test_fleet_batched_device_scoring(self, rng):
+        """Workers micro-batch concurrent requests into device batches
+        (the executor-listener + device-scoring path)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from mmlspark_tpu.core.dataframe import DataFrame
+        from mmlspark_tpu.io.serving import ServingFleet
+        from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+
+        x = rng.normal(size=(400, 3))
+        y = 2.0 * x[:, 0] + x[:, 1]
+        model = LightGBMRegressor(numIterations=5, numLeaves=4,
+                                  maxBin=16).fit(
+            DataFrame({"features": x, "label": y}))
+        expected = np.asarray(model.transform(
+            DataFrame({"features": x[:16], "label": y[:16]}))["prediction"])
+
+        with ServingFleet(model, num_servers=2, max_latency_ms=10,
+                          reply_col="prediction") as fleet:
+            def call_one(i):
+                url = fleet.worker_urls[i % 2]
+                return i, self._call(
+                    url, {"features": x[i].tolist(), "label": 0.0})
+
+            with ThreadPoolExecutor(max_workers=8) as ex:
+                results = list(ex.map(call_one, range(16)))
+        for i, out in results:
+            assert out["prediction"] == pytest.approx(expected[i], rel=1e-5)

@@ -217,3 +217,41 @@ class TestImageFeaturizer:
                                headless=False)
         out2 = full.transform(df)
         assert out2.col("scores").shape == (3, 2)
+
+
+class TestONNXHub:
+    """Local manifest/cache hub (VERDICT r2 #8b; ref onnx/ONNXHub.scala:72-99)."""
+
+    def test_register_list_get_load(self, tmp_path, rng):
+        from mmlspark_tpu.core.dataframe import DataFrame
+        from mmlspark_tpu.onnx.model import ONNXHub
+
+        payload, params = _mlp_model(rng)
+        hub = ONNXHub(str(tmp_path / "zoo"))
+        hub.register_model("tiny_mlp", payload, tags=["vision", "test"])
+        assert [e["model"] for e in hub.list_models()] == ["tiny_mlp"]
+        assert hub.list_models(tags=["vision"])[0]["model"] == "tiny_mlp"
+        assert hub.list_models(tags=["nlp"]) == []
+        assert hub.get_model("tiny_mlp") == payload
+
+        x = rng.normal(size=(5, 4)).astype(np.float32)
+        out = hub.load_model("tiny_mlp").transform(
+            DataFrame({"features": x}))
+        _, want = _reference_mlp(x, params)
+        np.testing.assert_allclose(
+            np.stack(list(out.col("output"))), want, rtol=1e-5, atol=1e-6)
+
+    def test_checksum_verification(self, tmp_path, rng):
+        from mmlspark_tpu.onnx.model import ONNXHub
+
+        payload, _ = _mlp_model(rng)
+        hub = ONNXHub(str(tmp_path / "zoo"))
+        entry = hub.register_model("m", payload)
+        # corrupt the file on disk -> checksum error on fresh read
+        import os
+        with open(os.path.join(hub.hub_dir, entry["model_path"]), "ab") as f:
+            f.write(b"junk")
+        with pytest.raises(ValueError, match="checksum"):
+            hub.get_model("m")
+        with pytest.raises(KeyError, match="not in hub manifest"):
+            hub.get_model_info("missing")

@@ -1,0 +1,81 @@
+"""Chunked host->device ingest (VERDICT r2 #9; reference
+StreamingPartitionTask.scala:203-277 micro-batch push)."""
+
+import time
+
+import numpy as np
+
+from mmlspark_tpu.ops.ingest import binned_ingest_dtype, chunked_device_put
+
+
+def test_chunked_matches_monolithic(rng):
+    import jax.numpy as jnp
+
+    x = rng.integers(0, 255, size=(10_000, 7)).astype(np.int32)
+    got = chunked_device_put(x, dtype=np.uint8, chunk_bytes=8_192)
+    assert got.dtype == jnp.uint8
+    np.testing.assert_array_equal(np.asarray(got), x.astype(np.uint8))
+    # small arrays fall through to one put
+    small = chunked_device_put(x[:8], dtype=np.uint8)
+    np.testing.assert_array_equal(np.asarray(small), x[:8].astype(np.uint8))
+
+
+def test_chunked_sharded_ingest(mesh8, rng):
+    from mmlspark_tpu.parallel.mesh import row_sharded
+
+    x = rng.integers(0, 64, size=(4_096, 5)).astype(np.int64)
+    got = chunked_device_put(x, row_sharded(mesh8, 2), dtype=np.uint8,
+                             chunk_bytes=4_096, row_multiple=8)
+    assert len({s.device for s in got.addressable_shards}) == 8
+    np.testing.assert_array_equal(np.asarray(got), x.astype(np.uint8))
+
+
+def test_binned_dtype_selection():
+    assert binned_ingest_dtype(255) == np.uint8
+    assert binned_ingest_dtype(256) == np.uint8
+    assert binned_ingest_dtype(257) == np.int32
+
+
+def test_uint8_binned_training_parity(rng):
+    """The trainer now ingests uint8 bins; results must match an int32
+    run bit-for-bit (promotion happens in index math, not data)."""
+    from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
+    from mmlspark_tpu.ops.binning import BinMapper
+
+    x = rng.normal(size=(2_000, 6))
+    y = (x[:, 0] - x[:, 1] > 0).astype(np.float64)
+    mapper = BinMapper.fit(x, max_bin=64)
+    binned = mapper.transform(x)
+    cfg = TrainConfig(objective="binary", num_iterations=4, num_leaves=8,
+                      max_depth=3, max_bin=64)
+    r1 = train(binned, y, cfg, bin_upper=mapper.bin_upper_values(64))
+    # the binned matrix arrives int32 from BinMapper; train() narrows it
+    assert r1.booster.num_trees == 4
+    cfg2 = TrainConfig(objective="binary", num_iterations=4, num_leaves=8,
+                       max_depth=3, max_bin=300)  # forces int32 path
+    r2 = train(np.asarray(binned, np.int32), y, cfg2,
+               bin_upper=np.pad(mapper.bin_upper_values(64),
+                                ((0, 0), (0, 300 - 64)),
+                                constant_values=np.inf))
+    p1 = np.asarray(r1.booster.predict_jit()(x))
+    p2 = np.asarray(r2.booster.predict_jit()(x))
+    np.testing.assert_allclose(p1, p2, atol=1e-6)
+
+
+def test_overlap_not_slower_than_monolithic(rng):
+    """Sanity: chunked ingest of a large array is within 2x of one put
+    (and usually faster once host prep is nontrivial)."""
+    import jax
+
+    x = rng.integers(0, 255, size=(400_000, 28)).astype(np.int32)
+
+    t0 = time.perf_counter()
+    a = jax.device_put(np.ascontiguousarray(x.astype(np.uint8)))
+    a.block_until_ready()
+    mono = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    b = chunked_device_put(x, dtype=np.uint8)
+    b.block_until_ready()
+    chunked = time.perf_counter() - t0
+    assert chunked < max(mono * 2.0, 0.5), (chunked, mono)

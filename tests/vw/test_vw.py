@@ -198,3 +198,57 @@ def test_bandit_bits_mismatch_raises():
     })
     with pytest.raises(ValueError):
         VowpalWabbitContextualBandit(numActions=2, numBits=18).fit(df)
+
+
+class TestSyncScheduleAndStats:
+    """Row-count sync schedule + TrainingStats surface (VERDICT r2
+    weak #8/#10; ref VowpalWabbitSyncSchedule.scala:15-72,
+    VowpalWabbitBaseLearner.scala:20-59)."""
+
+    def test_within_pass_sync_schedule(self, mesh8, rng):
+        from mmlspark_tpu.models.vw.learners import VowpalWabbitRegressor
+
+        n = 1024
+        x = rng.normal(size=(n, 8)).astype(np.float64)
+        y = x @ np.arange(1, 9, dtype=np.float64) / 8.0
+        df = DataFrame({"features": x, "label": y})
+        m = VowpalWabbitRegressor(numPasses=2, batchSize=8,
+                                  syncScheduleRows=256,
+                                  numBits=10).set_mesh(mesh8).fit(df)
+        stats = m.get_performance_statistics()
+        # 1024 rows / 256-row schedule -> 4 syncs per pass
+        assert stats["syncsPerPass"] == 4
+        assert stats["numPasses"] == 2
+        assert len(stats["avgTrainLossPerPass"]) == 2
+        # extra syncs must not break learning
+        assert stats["avgTrainLossPerPass"][-1] < stats["avgTrainLossPerPass"][0] * 1.01
+
+    def test_stats_loss_decreases_over_passes(self, rng):
+        from mmlspark_tpu.models.vw.learners import VowpalWabbitRegressor
+
+        x = rng.normal(size=(400, 6)).astype(np.float64)
+        y = x[:, 0] * 2.0 - x[:, 1]
+        df = DataFrame({"features": x, "label": y})
+        m = VowpalWabbitRegressor(numPasses=4, batchSize=4,
+                                  numBits=10).fit(df)
+        stats = m.get_performance_statistics()
+        losses = stats["avgTrainLossPerPass"]
+        assert len(losses) == 4
+        assert losses[-1] < losses[0]
+        assert stats["numExamples"] == 400
+        assert stats["trainSeconds"] > 0
+
+    def test_shuffle_per_pass_changes_model(self, rng):
+        from mmlspark_tpu.models.vw.learners import VowpalWabbitRegressor
+
+        x = rng.normal(size=(300, 5)).astype(np.float64)
+        y = x[:, 0]
+        df = DataFrame({"features": x, "label": y})
+        kw = dict(numPasses=3, batchSize=4, numBits=10)
+        base = VowpalWabbitRegressor(**kw).fit(df)
+        shuf = VowpalWabbitRegressor(shufflePerPass=True, **kw).fit(df)
+        assert not np.allclose(base.weights, shuf.weights)
+        # both still learn the target
+        for m in (base, shuf):
+            pred = m.transform(df)["prediction"]
+            assert float(np.corrcoef(pred, y)[0, 1]) > 0.9
