@@ -1,0 +1,115 @@
+"""Microbenchmark: GBDT histogram formulations at bench scale.
+
+The per-level histogram (binned (N,F) + grad/hess/live -> (width,F,B,3))
+is the flagship trainer's hot op (SURVEY.md §2.7 row 1). This script
+measures the candidate XLA formulations on the current backend so the
+trainer can adopt the winner per hardware:
+
+  A. stacked   — one segment_sum over (N*F, 3) rows (trainer default)
+  B. separate  — three scalar segment_sums sharing the index vector
+  C. per-feat  — fori_loop over features, (N, 3) segments each
+  D. scatter   — zeros.at[idx].add on the flat (width*F*B, 3) table
+
+Run: python bench_hist.py [N] [--cpu] (default 2_000_000). Prints one
+JSON line per variant.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    if "--cpu" in sys.argv:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n = int(args[0]) if args else 2_000_000
+    f, b, width = 28, 255, 32
+    rng = np.random.default_rng(0)
+    binned = jnp.asarray(rng.integers(0, b, size=(n, f), dtype=np.int32)
+                         .astype(np.uint8))
+    grad = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    hess = jnp.asarray(rng.uniform(0.1, 1.0, size=n).astype(np.float32))
+    live = jnp.asarray((rng.random(n) < 0.9).astype(np.float32))
+    local = jnp.asarray(rng.integers(0, width, size=n, dtype=np.int32))
+
+    def idx_flat():
+        base = (local[:, None] * f + jnp.arange(f)[None, :]) * b
+        return (base + binned).reshape(-1)
+
+    def variant_stacked():
+        idx = idx_flat()
+        data = jnp.stack([
+            jnp.broadcast_to((grad * live)[:, None], (n, f)).reshape(-1),
+            jnp.broadcast_to((hess * live)[:, None], (n, f)).reshape(-1),
+            jnp.broadcast_to(live[:, None], (n, f)).reshape(-1),
+        ], axis=-1)
+        return jax.ops.segment_sum(data, idx,
+                                   num_segments=width * f * b)
+
+    def variant_separate():
+        idx = idx_flat()
+        outs = []
+        for chan in (grad * live, hess * live, live):
+            flat = jnp.broadcast_to(chan[:, None], (n, f)).reshape(-1)
+            outs.append(jax.ops.segment_sum(flat, idx,
+                                            num_segments=width * f * b))
+        return jnp.stack(outs, axis=-1)
+
+    def variant_per_feature():
+        data = jnp.stack([grad * live, hess * live, live], axis=-1)
+
+        def body(fi, acc):
+            idx = (local * b + binned[:, fi].astype(jnp.int32)
+                   ).astype(jnp.int32)
+            h = jax.ops.segment_sum(data, idx, num_segments=width * b)
+            return acc.at[:, fi].set(h.reshape(width, b, 3))
+
+        acc = jnp.zeros((width, f, b, 3), jnp.float32)
+        return jax.lax.fori_loop(0, f, body, acc)
+
+    def variant_scatter():
+        idx = idx_flat()
+        data = jnp.stack([
+            jnp.broadcast_to((grad * live)[:, None], (n, f)).reshape(-1),
+            jnp.broadcast_to((hess * live)[:, None], (n, f)).reshape(-1),
+            jnp.broadcast_to(live[:, None], (n, f)).reshape(-1),
+        ], axis=-1)
+        return jnp.zeros((width * f * b, 3), jnp.float32).at[idx].add(data)
+
+    variants = {"stacked": variant_stacked, "separate": variant_separate,
+                "per_feature": variant_per_feature,
+                "scatter": variant_scatter}
+    results = {}
+    for name, fn in variants.items():
+        jitted = jax.jit(fn)
+        try:
+            jitted()[0].block_until_ready()  # compile
+            reps = 5
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = jitted()
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / reps
+        except Exception as e:  # a variant may not lower on a backend
+            print(json.dumps({"variant": name, "error": str(e)[:120]}))
+            continue
+        results[name] = dt
+        print(json.dumps({
+            "variant": name, "seconds_per_level": round(dt, 5),
+            "rows_per_s_M": round(n / dt / 1e6, 1),
+            "backend": jax.default_backend()}))
+    if results:
+        best = min(results, key=results.get)
+        print(json.dumps({"best": best,
+                          "speedup_vs_stacked": round(
+                              results.get("stacked", 0) / results[best], 2)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -71,10 +71,18 @@ class FabricClient:
         self._threads: List[threading.Thread] = []
 
     def emit(self, event: Dict[str, Any]) -> None:
+        def deep_scrub(v):
+            if isinstance(v, str):
+                return scrub(v)
+            if isinstance(v, dict):
+                return {k: deep_scrub(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return [deep_scrub(x) for x in v]
+            return v
+
         record = {"platform": detect_platform(),
                   "schemaVersion": 1,
-                  **{k: (scrub(v) if isinstance(v, str) else v)
-                     for k, v in event.items()}}
+                  **{k: deep_scrub(v) for k, v in event.items()}}
         if not self.endpoint:
             SINK.emit({"certifiedEvent": record})
             return

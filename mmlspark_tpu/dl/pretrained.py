@@ -113,15 +113,23 @@ class PretrainedBackboneParams:
                            to_bool, default=False)
 
     _backbone_payload: Optional[bytes] = None
+    _backbone_src: Optional[str] = None  # path the cache was loaded from
 
     def _uses_onnx_backbone(self) -> bool:
         return self._backbone_payload is not None or self.is_set(
             "backboneFile")
 
     def _onnx_module(self, num_classes: int) -> OnnxBackbone:
-        if self._backbone_payload is None:
-            self._backbone_payload = load_backbone_bytes(
-                self.get("backboneFile"))
+        path = (self.get("backboneFile") if self.is_set("backboneFile")
+                else None)
+        # reload when the param points somewhere new (a refit or a
+        # copy(backboneFile=...) must not reuse the old checkpoint); a
+        # state-restored model sets _backbone_src to its param value so
+        # the embedded payload wins even if the file is gone
+        if self._backbone_payload is None or (
+                path is not None and path != self._backbone_src):
+            self._backbone_payload = load_backbone_bytes(path)
+            self._backbone_src = path
         return OnnxBackbone(payload=self._backbone_payload,
                             num_classes=num_classes,
                             fetch=self.get("fetchTensor"),

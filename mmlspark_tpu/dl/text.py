@@ -67,6 +67,7 @@ class DeepTextClassifier(DeepEstimator, _TextParams):
                if DeepTextModel.has_param(p.name)})
         model._init_state(module, params, classes)
         model._backbone_payload = self._backbone_payload
+        model._backbone_src = self._backbone_src
         return model
 
 
@@ -99,4 +100,6 @@ class DeepTextModel(DeepModel, _TextParams):
         if state.get("onnx_payload") is not None:
             self._backbone_payload = bytes(
                 np.asarray(state["onnx_payload"], np.uint8))
+            self._backbone_src = (self.get("backboneFile")
+                                  if self.is_set("backboneFile") else None)
         super()._set_state(state)

@@ -61,6 +61,7 @@ class DeepVisionClassifier(DeepEstimator, PretrainedBackboneParams):
         model._init_state(module, params, classes)
         model._input_shape = None
         model._backbone_payload = self._backbone_payload
+        model._backbone_src = self._backbone_src
         return model
 
 
@@ -102,4 +103,6 @@ class DeepVisionModel(DeepModel, PretrainedBackboneParams):
         if state.get("onnx_payload") is not None:
             self._backbone_payload = bytes(
                 np.asarray(state["onnx_payload"], np.uint8))
+            self._backbone_src = (self.get("backboneFile")
+                                  if self.is_set("backboneFile") else None)
         super()._set_state(state)

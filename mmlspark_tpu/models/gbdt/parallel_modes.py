@@ -71,19 +71,12 @@ def _split_gains(hist, leaf_objective, cfg, b):
 
 
 def _histogram(binned, grad, hess, live, local, width, f, b):
-    import jax
-    import jax.numpy as jnp
+    # one shared formulation for every tree learner; these builders run
+    # inside shard_map, which constrains the choice (see helper doc)
+    from mmlspark_tpu.models.gbdt.trainer import _level_histogram
 
-    n = binned.shape[0]
-    base = (local[:, None] * f + jnp.arange(f)[None, :]) * b
-    idx = (base + binned).reshape(-1)
-    data = jnp.stack([
-        jnp.broadcast_to((grad * live)[:, None], (n, f)).reshape(-1),
-        jnp.broadcast_to((hess * live)[:, None], (n, f)).reshape(-1),
-        jnp.broadcast_to(live[:, None], (n, f)).reshape(-1),
-    ], axis=-1)
-    hist = jax.ops.segment_sum(data, idx, num_segments=width * f * b)
-    return hist.reshape(width, f, b, 3)
+    return _level_histogram(binned, grad, hess, live, local, width, f, b,
+                            in_shard_map=True)
 
 
 def make_build_tree_voting(num_features: int, total_bins: int, cfg,

@@ -330,7 +330,8 @@ class _VWBaseLearner(Estimator, _VWParams):
         margin = (w[idx.astype(np.int64)] * val).sum(axis=1) + bias
         if self._loss == "logistic":
             yy = np.where(y > 0, 1.0, -1.0)
-            per = np.log1p(np.exp(-yy * margin))
+            # logaddexp(0, x) = log(1+e^x) without overflow at large x
+            per = np.logaddexp(0.0, -yy * margin)
         elif self._loss == "quantile":
             d = y - margin
             per = np.maximum(0.5 * d, -0.5 * d)

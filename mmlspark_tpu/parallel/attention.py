@@ -96,6 +96,19 @@ def blockwise_attention(q, k, v, block_size: int = 512,
     return out / jnp.maximum(row_sum, 1e-30).transpose(0, 2, 1)[..., None]
 
 
+def fused_attention(q, k, v, causal: bool = False, block_size: int = 512):
+    """Single-device attention through the fastest available path: the
+    Pallas flash kernel on TPU (mmlspark_tpu.parallel.flash), else the
+    XLA blockwise scan."""
+    from mmlspark_tpu.parallel.flash import flash_attention, flash_available
+
+    n, nk = q.shape[1], k.shape[1]
+    if flash_available() and n % 128 == 0 and nk % 128 == 0:
+        return flash_attention(q, k, v, causal=causal)
+    return blockwise_attention(q, k, v, block_size=block_size,
+                               causal=causal)
+
+
 def ring_attention(q, k, v, mesh, causal: bool = False,
                    axis_name: str = SEQUENCE_AXIS):
     """Sequence-parallel attention: KV rotates around the ``sp`` ring.
