@@ -139,7 +139,11 @@ class DataFrame:
     def with_columns(self, new: Mapping[str, Any]) -> "DataFrame":
         cols = dict(self._cols)
         cols.update(new)
-        return DataFrame(cols, self._meta)
+        # replacing a column invalidates its metadata (same rule as
+        # with_column) — stale categorical flags would otherwise steer
+        # downstream consumers
+        meta = {k: v for k, v in self._meta.items() if k not in new}
+        return DataFrame(cols, meta)
 
     def select(self, *names: str) -> "DataFrame":
         return DataFrame({n: self.col(n) for n in names},

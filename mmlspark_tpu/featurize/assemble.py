@@ -24,16 +24,25 @@ class VectorAssembler(Transformer, HasOutputCol):
     def _transform(self, dataset: DataFrame) -> DataFrame:
         parts = []
         slot_names = []
+        categorical_slots = []
         for c in self.get("inputCols") or []:
             arr = dataset.col(c)
             if arr.dtype == object:
                 raise TypeError(f"VectorAssembler: column {c!r} is not numeric")
+            is_cat = bool(dataset.metadata(c).get("categorical"))
             if arr.ndim == 1:
+                if is_cat:  # Categoricals metadata -> slot metadata
+                    categorical_slots.append(len(slot_names))
                 parts.append(arr.astype(np.float64)[:, None])
                 slot_names.append(c)
             else:
+                if is_cat:
+                    categorical_slots.extend(
+                        range(len(slot_names), len(slot_names) + arr.shape[1]))
                 parts.append(arr.astype(np.float64))
                 slot_names.extend(f"{c}_{i}" for i in range(arr.shape[1]))
         out = np.hstack(parts) if parts else np.zeros((dataset.num_rows, 0))
         df = dataset.with_column(self.get("outputCol"), out)
-        return df.with_metadata(self.get("outputCol"), {"slots": slot_names})
+        return df.with_metadata(self.get("outputCol"),
+                                {"slots": slot_names,
+                                 "categorical_slots": categorical_slots})

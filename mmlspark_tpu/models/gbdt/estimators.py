@@ -92,6 +92,10 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCo
     categoricalSlotIndexes = Param("categoricalSlotIndexes",
                                    "indices of categorical features",
                                    to_list(to_int))
+    categoricalSlotNames = Param("categoricalSlotNames",
+                                 "slot names of categorical features "
+                                 "(resolved via the features column's "
+                                 "slot metadata)", to_list(to_str))
     catSmooth = Param("catSmooth", "categorical smoothing added to the "
                       "per-bin hessian in the sort ratio", to_float, ge(0),
                       default=10.0)
@@ -132,7 +136,9 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCo
                                      to_bool, default=False)
 
     def _train_config(self, objective: str, num_class: int = 1,
-                      sigmoid: float = 1.0, **extra: Any) -> TrainConfig:
+                      sigmoid: float = 1.0,
+                      categorical_features: List[int] = (),
+                      **extra: Any) -> TrainConfig:
         return TrainConfig(
             objective=objective,
             num_iterations=self.get("numIterations"),
@@ -157,8 +163,7 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCo
             sigmoid=sigmoid,
             early_stopping_round=self.get("earlyStoppingRound"),
             metric=self.get("metric"),
-            categorical_features=tuple(self.get("categoricalSlotIndexes")
-                                       or ()),
+            categorical_features=tuple(categorical_features),
             cat_smooth=self.get("catSmooth"),
             cat_l2=self.get("catL2"),
             max_cat_threshold=self.get("maxCatThreshold"),
@@ -201,6 +206,28 @@ class _LightGBMBase(Estimator, _LightGBMParams):
             return df.filter(~mask), df.filter(mask)
         return df, None
 
+    def _categorical_indexes(self, df: DataFrame) -> List[int]:
+        """Resolve categorical feature slots: explicit indexes, then
+        names via slot metadata, then the features column's
+        Categoricals metadata (getCategoricalIndexes analog,
+        LightGBMBase.scala + core/schema/Categoricals.scala)."""
+        out = set(self.get("categoricalSlotIndexes") or [])
+        meta = df.metadata(self.get("featuresCol"))
+        if self.is_set("categoricalSlotNames"):
+            slots = meta.get("slots")
+            if slots is None:
+                raise ValueError(
+                    "categoricalSlotNames needs slot metadata on the "
+                    "features column (assemble with VectorAssembler)")
+            by_name = {n: i for i, n in enumerate(slots)}
+            for name in self.get("categoricalSlotNames"):
+                if name not in by_name:
+                    raise ValueError(f"no feature slot named {name!r}; "
+                                     f"have {slots}")
+                out.add(by_name[name])
+        out.update(meta.get("categorical_slots") or [])
+        return sorted(out)
+
     def _fit_booster(self, df: DataFrame, objective: str, num_class: int = 1,
                      group_col: Optional[str] = None,
                      extra_cfg: Optional[Dict[str, Any]] = None):
@@ -219,7 +246,7 @@ class _LightGBMBase(Estimator, _LightGBMParams):
             if valid_df is not None and valid_df.num_rows:
                 vgroup_ids = encode_groups(valid_df)
         with measures.phase("binning"):
-            cat = self.get("categoricalSlotIndexes") or []
+            cat = self._categorical_indexes(df)
             mapper = BinMapper.fit(
                 _sample_rows(x, self.get("seed")), max_bin=self.get("maxBin"),
                 categorical_features=cat)
@@ -229,6 +256,7 @@ class _LightGBMBase(Estimator, _LightGBMParams):
             vx, vy, vw = self._extract(valid_df)
             valid_sets = [(mapper.transform(vx), vy, vw, vgroup_ids)]
         cfg = self._train_config(objective, num_class=num_class,
+                                 categorical_features=cat,
                                  **(extra_cfg or {}))
         init_model = None
         if self.is_set("modelString"):

@@ -186,3 +186,61 @@ class TestCategoricalSplits:
             for m in np.nonzero(internal[t])[0]:
                 assert b.count[t, 2 * m + 1] >= 5
                 assert b.count[t, 2 * m + 2] >= 5
+
+
+class TestCategoricalMetadataPlumbing:
+    """Categoricals metadata flows ValueIndexer -> VectorAssembler ->
+    LightGBM auto-detection (core/schema/Categoricals.scala analog)."""
+
+    def _pipeline_df(self, rng):
+        from mmlspark_tpu.core.dataframe import DataFrame
+
+        n, k = 2000, 16
+        cats = rng.integers(0, k, size=n)
+        good = np.array([2, 5, 9, 13])
+        noise = rng.normal(size=n)
+        y = (np.isin(cats, good) & (noise > -1)).astype(np.float64)
+        color = np.asarray([f"c{c}" for c in cats], dtype=object)
+        return DataFrame({"color": color, "num": noise, "label": y}), y
+
+    def test_auto_detection_via_metadata(self, rng):
+        from mmlspark_tpu.featurize.assemble import VectorAssembler
+        from mmlspark_tpu.featurize.indexer import ValueIndexer
+        from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+        df, y = self._pipeline_df(rng)
+        indexed = ValueIndexer(inputCol="color",
+                               outputCol="color_idx").fit(df).transform(df)
+        assembled = VectorAssembler(
+            inputCols=["color_idx", "num"], outputCol="features"
+        ).transform(indexed)
+        meta = assembled.metadata("features")
+        assert meta["categorical_slots"] == [0]
+        assert meta["slots"] == ["color_idx", "num"]
+
+        # no categoricalSlotIndexes set: detected from metadata
+        est = LightGBMClassifier(numIterations=10, numLeaves=8, maxDepth=3,
+                                 maxBin=32)
+        assert est._categorical_indexes(assembled) == [0]
+        model = est.fit(assembled)
+        assert model.booster.has_categorical
+        acc = float((model.transform(assembled)["prediction"] == y).mean())
+        assert acc > 0.9
+
+    def test_categorical_slot_names(self, rng):
+        from mmlspark_tpu.featurize.assemble import VectorAssembler
+        from mmlspark_tpu.featurize.indexer import ValueIndexer
+        from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+        df, y = self._pipeline_df(rng)
+        indexed = ValueIndexer(inputCol="color",
+                               outputCol="color_idx").fit(df).transform(df)
+        assembled = VectorAssembler(
+            inputCols=["num", "color_idx"], outputCol="features"
+        ).transform(indexed)
+        est = LightGBMClassifier(categoricalSlotNames=["color_idx"],
+                                 numIterations=2, numLeaves=4, maxBin=16)
+        assert est._categorical_indexes(assembled) == [1]
+        with pytest.raises(ValueError, match="no feature slot named"):
+            LightGBMClassifier(categoricalSlotNames=["nope"]
+                               )._categorical_indexes(assembled)
