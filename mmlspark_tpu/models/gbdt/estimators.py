@@ -107,6 +107,11 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCo
     maxCatToOnehot = Param("maxCatToOnehot", "use one-vs-rest splits when "
                            "a node has at most this many used categories",
                            to_int, gt(0), default=4)
+    monotoneConstraints = Param(
+        "monotoneConstraints", "per-feature -1/0/+1 monotone direction "
+        "(LightGBM monotone_constraints, basic method)", to_list(to_int))
+    minDataInBin = Param("minDataInBin", "min sampled rows per feature bin",
+                         to_int, gt(0), default=3)
     objective = Param("objective", "training objective", to_str)
     metric = Param("metric", "eval metric (default per objective)", to_str)
     modelString = Param("modelString", "warm-start model string", to_str)
@@ -168,6 +173,8 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCo
             cat_l2=self.get("catL2"),
             max_cat_threshold=self.get("maxCatThreshold"),
             max_cat_to_onehot=self.get("maxCatToOnehot"),
+            monotone_constraints=tuple(self.get("monotoneConstraints")
+                                       or ()),
             tree_learner={"data_parallel": "data",
                           "voting_parallel": "voting",
                           "feature_parallel": "feature",
@@ -249,7 +256,8 @@ class _LightGBMBase(Estimator, _LightGBMParams):
             cat = self._categorical_indexes(df)
             mapper = BinMapper.fit(
                 _sample_rows(x, self.get("seed")), max_bin=self.get("maxBin"),
-                categorical_features=cat)
+                categorical_features=cat,
+                min_data_in_bin=self.get("minDataInBin"))
             binned = mapper.transform(x)
         valid_sets = None
         if valid_df is not None and valid_df.num_rows:

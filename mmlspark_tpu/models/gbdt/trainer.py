@@ -93,6 +93,11 @@ class TrainConfig:
     cat_l2: float = 10.0          # extra L2 when evaluating cat splits
     max_cat_threshold: int = 32   # max categories on the scanned side
     max_cat_to_onehot: int = 4    # <=: one-vs-rest instead of sorted scan
+    # monotone constraints (LightGBM monotone_constraints, "basic"
+    # method): per-feature -1/0/+1; +1 forces predictions non-decreasing
+    # in the feature. Direction-violating splits are rejected and child
+    # subtrees are clamped to the split midpoint bound.
+    monotone_constraints: Any = ()
 
     def __post_init__(self):
         # eval_at may arrive as a list; the config is used as a cache key
@@ -102,6 +107,9 @@ class TrainConfig:
         if isinstance(self.categorical_features, (list, np.ndarray)):
             object.__setattr__(self, "categorical_features",
                                tuple(int(i) for i in self.categorical_features))
+        if isinstance(self.monotone_constraints, (list, np.ndarray)):
+            object.__setattr__(self, "monotone_constraints",
+                               tuple(int(i) for i in self.monotone_constraints))
 
     @property
     def effective_depth(self) -> int:
@@ -211,6 +219,14 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
     if cat_feats:
         is_cat_np[list(cat_feats)] = True
     has_cat = bool(is_cat_np.any())
+    mono_np = np.zeros(num_features, dtype=np.float32)
+    if cfg.monotone_constraints:
+        if len(cfg.monotone_constraints) > num_features:
+            raise ValueError(
+                f"monotone_constraints has {len(cfg.monotone_constraints)} "
+                f"entries but there are only {num_features} features")
+        mono_np[:len(cfg.monotone_constraints)] = cfg.monotone_constraints
+    has_mono = bool(mono_np.any())
 
     def leaf_objective(g, h, extra_l2=0.0):
         # L1-regularized leaf value and its score contribution
@@ -237,6 +253,11 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
         decision_type = jnp.zeros(num_slots, dtype=jnp.int8)
         bin_go_left = jnp.zeros((num_slots, b), dtype=jnp.bool_)
         is_cat_f = jnp.asarray(is_cat_np)
+        mono_f = jnp.asarray(mono_np)
+        # per-slot output bounds (monotone "basic" method): children of
+        # a constrained split may not cross the split midpoint
+        node_lower = jnp.full(num_slots, -jnp.inf, dtype=jnp.float32)
+        node_upper = jnp.full(num_slots, jnp.inf, dtype=jnp.float32)
         # root stats
         root_g, root_h, root_c = (jnp.sum(grad * valid), jnp.sum(hess * valid),
                                   jnp.sum(valid))
@@ -262,8 +283,8 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
             gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
             gt, ht, ct = tot[..., 0], tot[..., 1], tot[..., 2]
             gr, hr, cr = gt - gl, ht - hl, ct - cl
-            _, score_l = leaf_objective(gl, hl)
-            _, score_r = leaf_objective(gr, hr)
+            val_l, score_l = leaf_objective(gl, hl)
+            val_r, score_r = leaf_objective(gr, hr)
             _, score_p = leaf_objective(gt, ht)
             gain = 0.5 * (score_l + score_r - score_p)
             ok = ((cl >= min_child) & (cr >= min_child)
@@ -272,6 +293,10 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
             ok &= feat_mask[None, :, None] > 0
             # last bin can't split (right side empty by construction)
             ok &= jnp.arange(b)[None, None, :] < b - 1
+            if has_mono:
+                # reject splits whose child values violate the feature's
+                # monotone direction (LightGBM "basic" rejection)
+                ok &= mono_f[None, :, None] * (val_r - val_l) >= 0
             gain = jnp.where(ok, gain, -jnp.inf)
 
             if has_cat:
@@ -370,6 +395,27 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
             lval, _ = leaf_objective(left_stats[:, 0], left_stats[:, 1], lx2)
             rval, _ = leaf_objective(right_stats[:, 0], right_stats[:, 1], lx2)
             lslots, rslots = 2 * slots + 1, 2 * slots + 2
+            if has_mono:
+                # clamp child outputs into the parent's bounds, then
+                # tighten the children's bounds at the split midpoint
+                # when this split's feature is constrained
+                p_lo, p_hi = node_lower[slots], node_upper[slots]
+                lval = jnp.clip(lval, p_lo, p_hi)
+                rval = jnp.clip(rval, p_lo, p_hi)
+                c_mono = mono_f[best_feat] * (~chosen_cat)
+                mid = (lval + rval) / 2.0
+                l_hi = jnp.where(c_mono > 0, jnp.minimum(p_hi, mid), p_hi)
+                r_lo = jnp.where(c_mono > 0, jnp.maximum(p_lo, mid), p_lo)
+                l_lo = jnp.where(c_mono < 0, jnp.maximum(p_lo, mid), p_lo)
+                r_hi = jnp.where(c_mono < 0, jnp.minimum(p_hi, mid), p_hi)
+                node_lower = node_lower.at[lslots].set(
+                    jnp.where(do_split, l_lo, p_lo))
+                node_upper = node_upper.at[lslots].set(
+                    jnp.where(do_split, l_hi, p_hi))
+                node_lower = node_lower.at[rslots].set(
+                    jnp.where(do_split, r_lo, p_lo))
+                node_upper = node_upper.at[rslots].set(
+                    jnp.where(do_split, r_hi, p_hi))
             node_value = node_value.at[lslots].set(
                 jnp.where(do_split, lval, 0.0))
             node_value = node_value.at[rslots].set(
@@ -516,6 +562,11 @@ def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
             "tree learners; voting/feature parallel modes treat all "
             "features as numerical — drop categorical_features or use "
             "tree_learner='data'")
+    if mode in ("voting", "feature") and any(cfg.monotone_constraints or ()):
+        raise NotImplementedError(
+            "monotone constraints are implemented for the serial/data "
+            "tree learners; voting/feature parallel modes would silently "
+            "violate them — use tree_learner='data'")
     return _cache_put(_BUILDER_CACHE, (num_f, total_bins, cfg, mode, mesh),
                       build)
 
