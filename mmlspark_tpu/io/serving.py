@@ -283,6 +283,82 @@ class ServingFleet:
         self.stop()
 
 
+class FleetClient:
+    """Client-side load balancing + failover over a :class:`ServingFleet`.
+
+    The reference leaves request spraying to an external load balancer in
+    front of the executor listeners; here the registry makes workers
+    discoverable, and this client round-robins across them, retrying a
+    failed request on the next worker (the serving-path analog of
+    FaultToleranceUtils.retryWithTimeout,
+    core/utils/FaultToleranceUtils.scala:9-31)."""
+
+    def __init__(self, registry_url: str, timeout: float = 15.0,
+                 retries_per_worker: int = 1):
+        self.registry_url = registry_url
+        self.timeout = timeout
+        self.retries_per_worker = retries_per_worker
+        self._workers: List[str] = []
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def refresh(self) -> List[str]:
+        import urllib.request
+        with urllib.request.urlopen(self.registry_url,
+                                    timeout=self.timeout) as r:
+            workers = json.loads(r.read())["workers"]
+        with self._lock:
+            self._workers = workers
+        return list(workers)
+
+    def _pick(self) -> Optional[str]:
+        with self._lock:
+            if not self._workers:
+                return None
+            url = self._workers[self._next % len(self._workers)]
+            self._next += 1
+            return url
+
+    def score(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        import urllib.request
+        if not self._workers:
+            self.refresh()
+        n = max(len(self._workers), 1)
+        attempts = max(n * self.retries_per_worker, 1)
+        last: Optional[Exception] = None
+        for i in range(attempts):
+            url = self._pick()
+            if url is None:
+                raise RuntimeError(
+                    f"registry {self.registry_url} lists no workers")
+            try:
+                req = urllib.request.Request(
+                    url, data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    return json.loads(r.read())
+            except Exception as e:  # dead worker: fail over to the next
+                last = e
+                if i == attempts - 1:
+                    # last chance: addresses may be stale (fleet
+                    # restarted on fresh ports) — re-discover once
+                    try:
+                        self.refresh()
+                        url = self._pick()
+                        if url is not None:
+                            req = urllib.request.Request(
+                                url, data=json.dumps(payload).encode(),
+                                headers={"Content-Type":
+                                         "application/json"})
+                            with urllib.request.urlopen(
+                                    req, timeout=self.timeout) as r:
+                                return json.loads(r.read())
+                    except Exception as e2:
+                        last = e2
+        raise RuntimeError(
+            f"all workers failed after {attempts} attempts: {last}")
+
+
 def serve_pipeline(model: Transformer, **kwargs) -> ServingServer:
     """spark.readStream.server() analog: start serving a fitted model."""
     return ServingServer(model, **kwargs).start()

@@ -19,6 +19,7 @@ trainer's histogram reduction is XLA's all-reduce (data_parallel).
 
 from __future__ import annotations
 
+from dataclasses import replace
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -110,6 +111,13 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCo
     monotoneConstraints = Param(
         "monotoneConstraints", "per-feature -1/0/+1 monotone direction "
         "(LightGBM monotone_constraints, basic method)", to_list(to_int))
+    checkpointDir = Param(
+        "checkpointDir", "directory for mid-training model-string "
+        "checkpoints; a restarted fit resumes from the latest one "
+        "(elastic restart, SURVEY.md §5 checkpoint/resume)", to_str)
+    checkpointInterval = Param(
+        "checkpointInterval", "save a checkpoint every n iterations "
+        "(0 = off; requires checkpointDir)", to_int, ge(0), default=0)
     minDataInBin = Param("minDataInBin", "min sampled rows per feature bin",
                          to_int, gt(0), default=3)
     objective = Param("objective", "training objective", to_str)
@@ -282,6 +290,7 @@ class _LightGBMBase(Estimator, _LightGBMParams):
                                 dtype=np.float64)
 
         num_batches = self.get("numBatches")
+        ckpt_every = self.get("checkpointInterval")
         if num_batches and num_batches > 1:
             # sequential warm-started batches (LightGBMBase.scala:45-60)
             parts = np.array_split(np.arange(len(binned)), num_batches)
@@ -298,6 +307,56 @@ class _LightGBMBase(Estimator, _LightGBMParams):
                     else [init_scores(init_model, vx_raw)],
                     mesh=self._mesh, measures=measures)
                 init_model = result.booster
+        elif ckpt_every:
+            if not self.is_set("checkpointDir"):
+                raise ValueError(
+                    "checkpointInterval requires checkpointDir")
+            if self.get("earlyStoppingRound"):
+                raise ValueError(
+                    "checkpointing does not compose with early stopping: "
+                    "the no-improve counter cannot span warm-started "
+                    "segments — drop earlyStoppingRound or "
+                    "checkpointInterval")
+            # mid-training checkpoints + elastic restart: train in
+            # warm-started segments, persisting the model string after
+            # each; a restarted fit resumes from the latest checkpoint.
+            # iteration_offset continues the sampling RNG streams, so an
+            # uninterrupted segmented run matches a monolithic one.
+            import os
+            ckpt_dir = self.get("checkpointDir")
+            os.makedirs(ckpt_dir, exist_ok=True)
+            done = 0
+            latest = self._latest_checkpoint(ckpt_dir)
+            total = cfg.num_iterations
+            if latest is not None:
+                done, path = latest
+                if done > total:
+                    raise ValueError(
+                        f"checkpoint at iteration {done} in {ckpt_dir} "
+                        f"exceeds numIterations={total}; clear the "
+                        f"directory or raise numIterations")
+                with open(path) as fh:
+                    init_model = BoosterArrays.load_model_string(fh.read())
+            result = None
+            while done < total or result is None:
+                seg = min(ckpt_every, total - done)
+                result = train(
+                    binned, y, replace(cfg, num_iterations=seg),
+                    weights=w, group_ids=group_ids,
+                    bin_upper=mapper.bin_upper_values(cfg.max_bin),
+                    valid_sets=valid_sets, init_model=init_model,
+                    init_raw=init_scores(init_model, x),
+                    valid_init_raws=None if (init_model is None or vx_raw is None)
+                    else [init_scores(init_model, vx_raw)],
+                    mesh=self._mesh, measures=measures,
+                    iteration_offset=done)
+                init_model = result.booster
+                done += seg
+                tmp = os.path.join(ckpt_dir, f".checkpoint_{done}.tmp")
+                with open(tmp, "w") as fh:
+                    fh.write(result.booster.save_model_string())
+                os.replace(tmp, os.path.join(ckpt_dir,
+                                             f"checkpoint_{done}.txt"))
         else:
             result = train(
                 binned, y, cfg, weights=w, group_ids=group_ids,
@@ -308,6 +367,19 @@ class _LightGBMBase(Estimator, _LightGBMParams):
                 else [init_scores(init_model, vx_raw)],
                 mesh=self._mesh, measures=measures)
         return result, mapper, measures
+
+    @staticmethod
+    def _latest_checkpoint(ckpt_dir):
+        import os
+        import re
+        best = None
+        if os.path.isdir(ckpt_dir):
+            for name in os.listdir(ckpt_dir):
+                m = re.fullmatch(r"checkpoint_(\d+)\.txt", name)
+                if m and (best is None or int(m.group(1)) > best[0]):
+                    best = (int(m.group(1)),
+                            os.path.join(ckpt_dir, name))
+        return best
 
 
 class _LightGBMModelBase(Model, _LightGBMParams):

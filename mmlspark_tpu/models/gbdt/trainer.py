@@ -640,18 +640,24 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
         shrink = 1.0 if is_rf else data["lr"]
         n = labels.shape[0]
         rv = data["row_valid"]
-        raw, vraws, bag = carry
+        raw, vraws = carry
         # ----- sampling masks (device RNG, deterministic by seed) ----
         if bag_active:
-            kbag = jax.random.fold_in(jax.random.fold_in(base_key, 1), it)
-            use_frac = rf_frac if is_rf else frac
-            fresh = (jax.random.uniform(kbag, (n,)) < use_frac
-                     ).astype(jnp.float32) * rv
+            # key by the last refresh iteration rather than carrying the
+            # mask: iterations within a bagging period draw the same
+            # mask, and a resumed segment (iteration_offset) reproduces
+            # it exactly
             if freq > 0:
-                refresh = (it % freq) == 0
+                ref_it = it - (it % freq)
             else:
-                refresh = it == 0  # rf with no freq: one fixed bag
-            bag = jnp.where(refresh, fresh, bag)
+                ref_it = 0  # rf with no freq: one fixed bag
+            kbag = jax.random.fold_in(jax.random.fold_in(base_key, 1),
+                                      ref_it)
+            use_frac = rf_frac if is_rf else frac
+            sample_mask = (jax.random.uniform(kbag, (n,)) < use_frac
+                           ).astype(jnp.float32) * rv
+        else:
+            sample_mask = rv
         if cfg.feature_fraction < 1.0:
             keep = max(1, int(round(num_f * cfg.feature_fraction)))
             kf = jax.random.fold_in(jax.random.fold_in(base_key, 2), it)
@@ -667,7 +673,6 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
             okw["group_ids"] = groups
         g, h = objective_fn(score_in, labels, weights, **okw)
 
-        sample_mask = bag
         if is_goss:
             absg = jnp.abs(g) if k == 1 else jnp.sum(jnp.abs(g), axis=1)
             # padded rows are excluded from the gradient quantile
@@ -724,7 +729,7 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
             # numerical ones are fully derivable from threshold_bin, so
             # don't retain (num_slots, B) bools per iteration for them
             ys = ys + (jnp.stack(dts), jnp.stack(bgls))
-        return (raw, tuple(new_vraws), bag), ys
+        return (raw, tuple(new_vraws)), ys
 
 
     return jax.jit(step)
@@ -760,7 +765,7 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
           custom_objective: Optional[Callable] = None,
           mesh=None,
           callbacks: Optional[List[Callable[[int, Dict[str, float]], None]]] = None,
-          measures=None) -> TrainResult:
+          measures=None, iteration_offset: int = 0) -> TrainResult:
     """Boosting loop. ``binned``: (N,F) int32 bin ids; ``bin_upper``:
     (F,B) raw-value bin upper edges (threshold materialization).
 
@@ -917,13 +922,14 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
             cfg, k, num_f, total_bins, depth, binned_d, labels_d, weights_d,
             group_ids_dev, raw, valid_states, custom_objective, mesh,
             metric_name, metric_list, higher_better, metric_kwargs,
-            base_score, callbacks, measures, n, row_valid)
+            base_score, callbacks, measures, n, row_valid,
+            iteration_offset)
     else:
         trees, tree_weights, evals, best_iter = _train_scan(
             cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
             group_ids_dev, raw, valid_states, mesh,
             metric_list, higher_better, base_score, callbacks, measures,
-            row_valid_d)
+            row_valid_d, iteration_offset)
     trees_sf, trees_tb, trees_nv, trees_cnt, trees_dt, trees_bgl = trees
 
     num_trees = len(trees_sf)
@@ -999,7 +1005,7 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
 def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
                 group_ids_dev, raw, valid_states, mesh,
                 metric_list, higher_better, base_score, callbacks, measures,
-                row_valid_d=None):
+                row_valid_d=None, iteration_offset=0):
     """Fused device loop: one async dispatch per iteration, zero host
     syncs inside the loop. Early stopping syncs the (tiny) metric matrix
     in blocks of ``early_stopping_round`` and truncates post hoc — trees
@@ -1029,9 +1035,7 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
             "groups": vs["group_ids"],
         } for vs in valid_states),
     }
-    carry = (raw, tuple(vs["raw"] for vs in valid_states),
-             row_valid_d if row_valid_d is not None
-             else jnp.ones(labels_d.shape[0], jnp.float32))
+    carry = (raw, tuple(vs["raw"] for vs in valid_states))
 
     # metric record layout must match the step body's stacking order
     labels_order = []
@@ -1083,7 +1087,7 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
     it = 0
     while it < total:
         with measures.phase("training"):
-            carry, ys = step_fn(data, carry, it)
+            carry, ys = step_fn(data, carry, it + iteration_offset)
             outs.append(ys)
             it += 1
         if callbacks:
@@ -1162,7 +1166,7 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
                 weights_d, group_ids_dev, raw, valid_states,
                 custom_objective, mesh, metric_name, metric_list,
                 higher_better, metric_kwargs, base_score, callbacks,
-                measures, n, row_valid=None):
+                measures, n, row_valid=None, iteration_offset=0):
     """Per-iteration eager host loop. Used for (a) DART, whose
     dropped-tree set is a dynamically sized subset of all prior trees
     that doesn't fit a fixed-shape compiled step, and (b) custom
@@ -1184,7 +1188,10 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
     if cfg.objective == "lambdarank":
         obj_kwargs = {"group_ids": group_ids_dev, "sigmoid": cfg.sigmoid}
 
-    rng = np.random.default_rng(cfg.seed)
+    # offset keys the host/device RNG streams so a resumed segment
+    # continues rather than replays (exact on the fused path; the eager
+    # loop's host RNG re-seeds per segment)
+    rng = np.random.default_rng(cfg.seed + iteration_offset)
     trees_sf, trees_tb, trees_nv, trees_cnt = [], [], [], []
     trees_dt, trees_bgl = [], []
     tree_weights: List[float] = []
@@ -1241,7 +1248,8 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
                 1.0 - cfg.top_rate)
             big = absg >= thr
             key = jax.random.fold_in(
-                jax.random.fold_in(jax.random.key(cfg.seed), 3), it)
+                jax.random.fold_in(jax.random.key(cfg.seed), 3),
+                it + iteration_offset)
             small_keep = jax.random.uniform(key, absg.shape) < (
                 cfg.other_rate / max(1.0 - cfg.top_rate, 1e-12))
             amplify = (1.0 - cfg.top_rate) / max(cfg.other_rate, 1e-12)
