@@ -20,7 +20,7 @@ from typing import Any, Dict, List
 
 import numpy as np
 
-from mmlspark_tpu.core.param import Param, to_bool, to_str
+from mmlspark_tpu.core.param import Param, to_bool, to_float, to_int, to_str
 from mmlspark_tpu.io.cognitive import CognitiveServiceTransformer
 
 
@@ -154,6 +154,139 @@ class DetectAnomalies(_AnomalyBase):
 # ---------------------------------------------------------------------------
 # Vision + face (vision/ComputerVision.scala, face/Face.scala)
 # ---------------------------------------------------------------------------
+
+class _AsyncCognitiveBase(CognitiveServiceTransformer):
+    """Async long-running-operation protocol: POST returns 202 with an
+    ``Operation-Location`` header; the client polls that URL until the
+    operation reports success, then parses the result. The reference's
+    form-recognizer and multivariate-anomaly families speak exactly this
+    protocol (services/CognitiveServiceBase.scala handleResponse +
+    anomaly/MultivariateAnomalyDetection.scala:1).
+    """
+
+    pollingIntervalSec = Param("pollingIntervalSec", "seconds between "
+                               "status polls", to_float, default=0.5)
+    maxPollRetries = Param("maxPollRetries", "max status polls before "
+                           "giving up", to_int, default=40)
+
+    def _open_retrying(self, req):
+        """urlopen with the family's transient-error policy: retry
+        429/5xx with backoff (Retry-After honored), like the sync
+        transformers' HTTP layer (io/http.py)."""
+        import time as _time
+        import urllib.error
+        import urllib.request
+
+        delays = (0.0, 0.2, 1.0)
+        last = None
+        for delay in delays:
+            if delay:
+                _time.sleep(delay)
+            try:
+                return urllib.request.urlopen(req,
+                                              timeout=self.get("timeout"))
+            except urllib.error.HTTPError as e:
+                last = e
+                if e.code != 429 and e.code < 500:
+                    raise
+                retry_after = e.headers.get("Retry-After")
+                if retry_after:
+                    _time.sleep(min(float(retry_after), 5.0))
+        raise last
+
+    def _run_one(self, row):
+        import json as _json
+        import time as _time
+        import urllib.request
+
+        headers = {"Content-Type": "application/json", **self._headers()}
+        body = _json.dumps(self._build_body(row)).encode()
+        req = urllib.request.Request(self.get("url"), data=body,
+                                     headers=headers)
+        with self._open_retrying(req) as r:
+            op_url = r.headers.get("Operation-Location")
+        if not op_url:
+            raise RuntimeError(
+                "service returned no Operation-Location header")
+        for _ in range(self.get("maxPollRetries")):
+            poll = urllib.request.Request(op_url, headers=headers)
+            with self._open_retrying(poll) as r:
+                status = _json.loads(r.read())
+            state = str(status.get("status", "")).lower()
+            if state in ("succeeded", "ready"):
+                return self._parse(status)
+            if state in ("failed", "error"):
+                raise RuntimeError(
+                    f"operation failed: {status.get('error')}")
+            _time.sleep(self.get("pollingIntervalSec"))
+        raise TimeoutError(f"operation did not complete within "
+                           f"{self.get('maxPollRetries')} polls")
+
+    def _transform(self, dataset):
+        from concurrent.futures import ThreadPoolExecutor
+
+        outputs = np.empty(dataset.num_rows, dtype=object)
+        errors = np.empty(dataset.num_rows, dtype=object)
+
+        def work(i_row):
+            i, row = i_row
+            try:
+                return i, self._run_one(row), None
+            except Exception as e:
+                return i, None, str(e)
+
+        rows = list(enumerate(dataset.iter_rows()))
+        # polls dominate wall-clock: overlap rows up to `concurrency`
+        # like the sync family's async HTTP layer
+        with ThreadPoolExecutor(max_workers=max(
+                self.get("concurrency"), 1)) as ex:
+            for i, out, err in ex.map(work, rows):
+                outputs[i] = out
+                errors[i] = err
+        return (dataset.with_column(self.get("outputCol"), outputs)
+                .with_column(self.get("errorCol"), errors))
+
+
+class AnalyzeDocument(_AsyncCognitiveBase):
+    """Form-recognizer layout/document analysis via the async protocol
+    (the reference's form family, form/FormRecognizer.scala)."""
+
+    imageUrlCol = Param("imageUrlCol", "document url column", to_str,
+                        default="url")
+
+    def _build_body(self, row):
+        return {"urlSource": str(row[self.get("imageUrlCol")])}
+
+    def _parse(self, status):
+        res = status.get("analyzeResult", {})
+        return {"content": res.get("content"),
+                "pages": len(res.get("pages", [])),
+                "keyValuePairs": res.get("keyValuePairs", [])}
+
+
+class FitMultivariateAnomaly(_AsyncCognitiveBase):
+    """Multivariate anomaly detection via the async train/infer protocol
+    (anomaly/MultivariateAnomalyDetection.scala:1): the body points the
+    service at a data source + time window; the poll result carries the
+    trained model id / inference results."""
+
+    dataSourceCol = Param("dataSourceCol", "column holding the data "
+                          "source URI", to_str, default="source")
+    startTime = Param("startTime", "window start (ISO8601)", to_str)
+    endTime = Param("endTime", "window end (ISO8601)", to_str)
+
+    def _build_body(self, row):
+        body = {"dataSource": str(row[self.get("dataSourceCol")])}
+        if self.is_set("startTime"):
+            body["startTime"] = self.get("startTime")
+        if self.is_set("endTime"):
+            body["endTime"] = self.get("endTime")
+        return body
+
+    def _parse(self, status):
+        return {"modelId": status.get("modelId"),
+                "results": status.get("results", [])}
+
 
 class _ImageUrlBase(CognitiveServiceTransformer):
     imageUrlCol = Param("imageUrlCol", "image url column", to_str,

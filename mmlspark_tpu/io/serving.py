@@ -337,8 +337,11 @@ class FleetClient:
                     headers={"Content-Type": "application/json"})
                 with urllib.request.urlopen(req, timeout=self.timeout) as r:
                     return json.loads(r.read())
-            except Exception as e:  # dead worker: fail over to the next
+            except Exception as e:  # dead worker: evict + fail over
                 last = e
+                with self._lock:
+                    if url in self._workers:
+                        self._workers.remove(url)
                 if i == attempts - 1:
                     # last chance: addresses may be stale (fleet
                     # restarted on fresh ports) — re-discover once

@@ -75,6 +75,20 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCo
     baggingFreq = Param("baggingFreq", "re-bag every k iterations", to_int,
                         ge(0), default=0)
     baggingSeed = Param("baggingSeed", "bagging seed", to_int, default=3)
+    posBaggingFraction = Param("posBaggingFraction", "bagging rate for "
+                               "positive binary rows", to_float,
+                               in_range(0, 1, lo_inclusive=False), default=1.0)
+    negBaggingFraction = Param("negBaggingFraction", "bagging rate for "
+                               "negative binary rows", to_float,
+                               in_range(0, 1, lo_inclusive=False), default=1.0)
+    pathSmooth = Param("pathSmooth", "smooth child outputs toward the "
+                       "parent by n/(n+pathSmooth)", to_float, ge(0),
+                       default=0.0)
+    maxDeltaStep = Param("maxDeltaStep", "clamp |leaf output| (0 = off)",
+                         to_float, ge(0), default=0.0)
+    extraTrees = Param("extraTrees", "evaluate one random threshold per "
+                       "node/feature (extremely randomized trees)",
+                       to_bool, default=False)
     boostingType = Param("boostingType", "gbdt | rf | dart | goss", to_str,
                          one_of("gbdt", "rf", "dart", "goss"), default="gbdt")
     topRate = Param("topRate", "GOSS large-gradient keep rate", to_float,
@@ -183,6 +197,11 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCo
             max_cat_to_onehot=self.get("maxCatToOnehot"),
             monotone_constraints=tuple(self.get("monotoneConstraints")
                                        or ()),
+            pos_bagging_fraction=self.get("posBaggingFraction"),
+            neg_bagging_fraction=self.get("negBaggingFraction"),
+            path_smooth=self.get("pathSmooth"),
+            max_delta_step=self.get("maxDeltaStep"),
+            extra_trees=self.get("extraTrees"),
             tree_learner={"data_parallel": "data",
                           "voting_parallel": "voting",
                           "feature_parallel": "feature",
@@ -291,6 +310,11 @@ class _LightGBMBase(Estimator, _LightGBMParams):
 
         num_batches = self.get("numBatches")
         ckpt_every = self.get("checkpointInterval")
+        if ckpt_every and num_batches and num_batches > 1:
+            raise ValueError(
+                "checkpointInterval does not compose with numBatches "
+                "(sequential data batches already warm-start); use one "
+                "or the other")
         if num_batches and num_batches > 1:
             # sequential warm-started batches (LightGBMBase.scala:45-60)
             parts = np.array_split(np.arange(len(binned)), num_batches)
@@ -316,6 +340,12 @@ class _LightGBMBase(Estimator, _LightGBMParams):
                     "checkpointing does not compose with early stopping: "
                     "the no-improve counter cannot span warm-started "
                     "segments — drop earlyStoppingRound or "
+                    "checkpointInterval")
+            if self.get("boostingType") == "dart":
+                raise ValueError(
+                    "checkpointing does not compose with DART: trees "
+                    "frozen into a checkpoint can no longer be dropped "
+                    "or renormalized — drop boostingType='dart' or "
                     "checkpointInterval")
             # mid-training checkpoints + elastic restart: train in
             # warm-started segments, persisting the model string after

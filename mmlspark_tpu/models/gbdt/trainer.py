@@ -98,6 +98,19 @@ class TrainConfig:
     # in the feature. Direction-violating splits are rejected and child
     # subtrees are clamped to the split midpoint bound.
     monotone_constraints: Any = ()
+    # LightGBM path_smooth: child outputs shrink toward the parent's by
+    # n/(n+path_smooth); applied at value recording (split selection
+    # still uses unsmoothed scores)
+    path_smooth: float = 0.0
+    # LightGBM max_delta_step: clamp |leaf output| (0 = off)
+    max_delta_step: float = 0.0
+    # LightGBM pos/neg_bagging_fraction: per-class bagging rates for
+    # binary labels (both default 1.0 = plain bagging_fraction)
+    pos_bagging_fraction: float = 1.0
+    neg_bagging_fraction: float = 1.0
+    # LightGBM extra_trees: evaluate ONE random threshold per
+    # (node, feature) instead of scanning every bin
+    extra_trees: bool = False
 
     def __post_init__(self):
         # eval_at may arrive as a list; the config is used as a cache key
@@ -236,10 +249,14 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
         score = g_adj * g_adj / denom
         return value, score
 
-    def build_tree(binned, grad, hess, valid, feat_mask, remaining_leaves):
+    def build_tree(binned, grad, hess, valid, feat_mask, remaining_leaves,
+                   key=None):
         """binned (N,F) int32; grad/hess (N,) f32; valid (N,) f32 row mask
         (bagging/GOSS already folded into grad/hess scaling + this mask);
-        feat_mask (F,) f32; remaining_leaves traced int."""
+        feat_mask (F,) f32; remaining_leaves traced int; key seeds the
+        extra_trees random thresholds (required when extra_trees)."""
+        if cfg.extra_trees and key is None:
+            raise ValueError("extra_trees needs an rng key")
         n = binned.shape[0]
         f = num_features
         b = total_bins
@@ -262,6 +279,8 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
         root_g, root_h, root_c = (jnp.sum(grad * valid), jnp.sum(hess * valid),
                                   jnp.sum(valid))
         rv, _ = leaf_objective(root_g, root_h)
+        if cfg.max_delta_step > 0:
+            rv = jnp.clip(rv, -cfg.max_delta_step, cfg.max_delta_step)
         node_value = node_value.at[0].set(rv)
         node_count = node_count.at[0].set(root_c)
 
@@ -297,6 +316,11 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
                 # reject splits whose child values violate the feature's
                 # monotone direction (LightGBM "basic" rejection)
                 ok &= mono_f[None, :, None] * (val_r - val_l) >= 0
+            if cfg.extra_trees:
+                # one random candidate threshold per (node, feature)
+                kd = jax.random.fold_in(key, d)
+                rand_bin = jax.random.randint(kd, (width, f), 0, b - 1)
+                ok &= jnp.arange(b)[None, None, :] == rand_bin[..., None]
             gain = jnp.where(ok, gain, -jnp.inf)
 
             if has_cat:
@@ -395,6 +419,18 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
             lval, _ = leaf_objective(left_stats[:, 0], left_stats[:, 1], lx2)
             rval, _ = leaf_objective(right_stats[:, 0], right_stats[:, 1], lx2)
             lslots, rslots = 2 * slots + 1, 2 * slots + 2
+            if cfg.path_smooth > 0:
+                # shrink child outputs toward the parent's by n/(n+ps)
+                pv = node_value[slots]
+                wl = left_stats[:, 2] / (left_stats[:, 2] + cfg.path_smooth)
+                wr = right_stats[:, 2] / (right_stats[:, 2] + cfg.path_smooth)
+                lval = lval * wl + pv * (1.0 - wl)
+                rval = rval * wr + pv * (1.0 - wr)
+            if cfg.max_delta_step > 0:
+                lval = jnp.clip(lval, -cfg.max_delta_step,
+                                cfg.max_delta_step)
+                rval = jnp.clip(rval, -cfg.max_delta_step,
+                                cfg.max_delta_step)
             if has_mono:
                 # clamp child outputs into the parent's bounds, then
                 # tighten the children's bounds at the split midpoint
@@ -567,6 +603,10 @@ def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
             "monotone constraints are implemented for the serial/data "
             "tree learners; voting/feature parallel modes would silently "
             "violate them — use tree_learner='data'")
+    if mode in ("voting", "feature") and cfg.extra_trees:
+        raise NotImplementedError(
+            "extra_trees is implemented for the serial/data tree "
+            "learners — use tree_learner='data'")
     return _cache_put(_BUILDER_CACHE, (num_f, total_bins, cfg, mode, mesh),
                       build)
 
@@ -627,7 +667,9 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
     nl = cfg.num_leaves if cfg.num_leaves > 0 else 2 ** depth
     frac = cfg.bagging_fraction
     freq = cfg.bagging_freq
-    bag_active = (freq > 0 and frac < 1.0) or is_rf
+    pos_neg = (cfg.pos_bagging_fraction < 1.0
+               or cfg.neg_bagging_fraction < 1.0)
+    bag_active = (freq > 0 and (frac < 1.0 or pos_neg)) or is_rf
     rf_frac = frac if frac < 1.0 else 0.632
 
     def step(data, carry, it):
@@ -653,9 +695,16 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
                 ref_it = 0  # rf with no freq: one fixed bag
             kbag = jax.random.fold_in(jax.random.fold_in(base_key, 1),
                                       ref_it)
-            use_frac = rf_frac if is_rf else frac
-            sample_mask = (jax.random.uniform(kbag, (n,)) < use_frac
-                           ).astype(jnp.float32) * rv
+            draw = jax.random.uniform(kbag, (n,))
+            if pos_neg and not is_rf:
+                # per-class rates (LightGBM pos/neg_bagging_fraction)
+                thr_vec = jnp.where(labels > 0,
+                                    cfg.pos_bagging_fraction,
+                                    cfg.neg_bagging_fraction)
+                sample_mask = (draw < thr_vec).astype(jnp.float32) * rv
+            else:
+                use_frac = rf_frac if is_rf else frac
+                sample_mask = (draw < use_frac).astype(jnp.float32) * rv
         else:
             sample_mask = rv
         if cfg.feature_fraction < 1.0:
@@ -694,9 +743,18 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
         for cls in range(k):
             gc = g if k == 1 else g[:, cls]
             hc = h if k == 1 else h[:, cls]
-            sf, tb, nv, cnt, dt, bgl = build_tree(
-                binned, gc.astype(jnp.float32), hc.astype(jnp.float32),
-                sample_mask.astype(jnp.float32), feat_mask, jnp.int32(nl))
+            if cfg.extra_trees:
+                kt = jax.random.fold_in(
+                    jax.random.fold_in(base_key, 4 + cls), it)
+                sf, tb, nv, cnt, dt, bgl = build_tree(
+                    binned, gc.astype(jnp.float32), hc.astype(jnp.float32),
+                    sample_mask.astype(jnp.float32), feat_mask,
+                    jnp.int32(nl), key=kt)
+            else:
+                sf, tb, nv, cnt, dt, bgl = build_tree(
+                    binned, gc.astype(jnp.float32), hc.astype(jnp.float32),
+                    sample_mask.astype(jnp.float32), feat_mask,
+                    jnp.int32(nl))
             nv = nv * shrink
             sfs.append(sf); tbs.append(tb); nvs.append(nv); cnts.append(cnt)
             dts.append(dt); bgls.append(bgl)
@@ -799,6 +857,12 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
 
     if cfg.objective == "lambdarank" and group_ids is None:
         raise ValueError("lambdarank requires group_ids")
+    if (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0) \
+            and cfg.objective != "binary":
+        raise ValueError(
+            "pos/neg_bagging_fraction applies to the binary objective "
+            "only (LightGBM semantics); got objective="
+            f"{cfg.objective!r}")
 
     with measures.phase("dataPreparation"):
         if init_model is not None:
@@ -1204,13 +1268,23 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
 
     rv_host = (np.ones(n, dtype=np.float32) if row_valid is None
                else np.asarray(row_valid, dtype=np.float32))
+    pos_neg = (cfg.pos_bagging_fraction < 1.0
+               or cfg.neg_bagging_fraction < 1.0)
+    labels_host = np.asarray(labels_d) if pos_neg else None
     bag_mask = rv_host.copy()
     for it in range(cfg.num_iterations):
         # ----- sampling masks (host RNG, deterministic by seed) ----------
-        if (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
+        if (cfg.bagging_freq > 0
+                and (cfg.bagging_fraction < 1.0 or pos_neg)
                 and it % cfg.bagging_freq == 0) or (is_rf and it == 0):
-            frac = cfg.bagging_fraction if cfg.bagging_fraction < 1.0 else 0.632
-            bag_mask = (rng.random(n) < frac).astype(np.float32) * rv_host
+            if pos_neg and not is_rf:
+                thr_vec = np.where(labels_host > 0,
+                                   cfg.pos_bagging_fraction,
+                                   cfg.neg_bagging_fraction)
+                bag_mask = (rng.random(n) < thr_vec).astype(np.float32) * rv_host
+            else:
+                frac = cfg.bagging_fraction if cfg.bagging_fraction < 1.0 else 0.632
+                bag_mask = (rng.random(n) < frac).astype(np.float32) * rv_host
         feat_mask = np.ones(num_f, dtype=np.float32)
         if cfg.feature_fraction < 1.0:
             keep = max(1, int(round(num_f * cfg.feature_fraction)))
@@ -1264,12 +1338,18 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
             gc = g if k == 1 else g[:, cls]
             hc = h if k == 1 else h[:, cls]
             with measures.phase("training"):
+                kw = {}
+                if cfg.extra_trees:
+                    kw["key"] = jax.random.fold_in(jax.random.fold_in(
+                        jax.random.key(cfg.seed), 4 + cls),
+                        it + iteration_offset)
                 sf, tb, nv, cnt, dt, bgl = build_tree(
                     binned_d, jnp.asarray(gc, jnp.float32),
                     jnp.asarray(hc, jnp.float32),
                     sample_mask.astype(jnp.float32),
                     jnp.asarray(feat_mask),
-                    jnp.int32(cfg.num_leaves if cfg.num_leaves > 0 else 2 ** depth))
+                    jnp.int32(cfg.num_leaves if cfg.num_leaves > 0 else 2 ** depth),
+                    **kw)
             nv = nv * (1.0 if is_rf else cfg.learning_rate)
             trees_sf.append(np.asarray(sf))
             trees_tb.append(np.asarray(tb))

@@ -522,6 +522,8 @@ EXEMPT: Dict[str, str] = {
     "DescribeImage": "needs a live endpoint; covered by tests/io",
     "OCR": "needs a live endpoint; covered by tests/io",
     "DetectFace": "needs a live endpoint; covered by tests/io",
+    "AnalyzeDocument": "needs a live endpoint; covered by tests/io",
+    "FitMultivariateAnomaly": "needs a live endpoint; covered by tests/io",
     "ImageFeaturizer": "covered by tests/onnx with a real graph",
     "ImageLIME": "superpixel loop too slow for fuzzing; tests/explainers",
     "ImageSHAP": "superpixel loop too slow for fuzzing; tests/explainers",

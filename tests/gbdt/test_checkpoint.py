@@ -70,22 +70,3 @@ def test_checkpointed_fit_with_sampling_matches(reg_df, tmp_path):
             np.asarray(mono.transform(df)["prediction"]),
             np.asarray(ck.transform(df)["prediction"]), atol=1e-4)
 
-
-def test_fleet_client_failover(rng):
-    """FleetClient retries a dead worker's request on live workers
-    (serving-path fault tolerance, FaultToleranceUtils analog)."""
-    from mmlspark_tpu.core.pipeline import Transformer
-    from mmlspark_tpu.io.serving import FleetClient, ServingFleet
-
-    class _Double(Transformer):
-        def _transform(self, df):
-            return df.with_column("doubled",
-                                  np.asarray(df.col("x")) * 2.0)
-
-    with ServingFleet(_Double(), num_servers=3, max_latency_ms=5) as fleet:
-        client = FleetClient(fleet.registry_url, timeout=5.0)
-        assert len(client.refresh()) == 3
-        # kill one worker; round-robin requests must still all succeed
-        fleet.servers[1].stop()
-        outs = [client.score({"x": float(i)}) for i in range(9)]
-        assert [o["doubled"] for o in outs] == [2.0 * i for i in range(9)]

@@ -1,0 +1,83 @@
+"""Extended LightGBM param surface: pathSmooth, maxDeltaStep,
+pos/negBaggingFraction, extraTrees (params/LightGBMParams.scala)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.models.gbdt.estimators import (LightGBMClassifier,
+                                                 LightGBMRegressor)
+
+
+@pytest.fixture()
+def reg_df(rng):
+    x = rng.normal(size=(1200, 4))
+    y = 2.0 * x[:, 0] - x[:, 1] + rng.normal(size=1200) * 0.3
+    return DataFrame({"features": x, "label": y}), x, y
+
+
+def test_max_delta_step_bounds_leaf_outputs(reg_df):
+    df, x, y = reg_df
+    kw = dict(numIterations=5, numLeaves=8, maxBin=32, learningRate=1.0)
+    free = LightGBMRegressor(**kw).fit(df)
+    capped = LightGBMRegressor(maxDeltaStep=0.1, **kw).fit(df)
+    # every stored node value (pre-shrinkage output) obeys the cap
+    leaf_mask = capped.booster.split_feature < 0
+    assert float(np.abs(capped.booster.node_value).max()) <= 0.1 + 1e-6
+    assert float(np.abs(free.booster.node_value).max()) > 0.1
+
+
+def test_path_smooth_shrinks_toward_parent(reg_df):
+    df, x, y = reg_df
+    kw = dict(numIterations=5, numLeaves=8, maxBin=32)
+    free = LightGBMRegressor(**kw).fit(df)
+    smooth = LightGBMRegressor(pathSmooth=1e6, **kw).fit(df)
+    # huge smoothing: children barely move off the parent -> predictions
+    # hug the base score far more than the free fit
+    pf = np.asarray(free.transform(df)["prediction"])
+    ps = np.asarray(smooth.transform(df)["prediction"])
+    assert np.std(ps) < np.std(pf) * 0.2
+    # mild smoothing barely changes quality
+    mild = LightGBMRegressor(pathSmooth=1.0, **kw).fit(df)
+    pm = np.asarray(mild.transform(df)["prediction"])
+    assert np.corrcoef(pm, y)[0, 1] > 0.9
+
+
+def test_pos_neg_bagging_fraction(rng):
+    x = rng.normal(size=(3000, 3))
+    y = (x[:, 0] > 1.0).astype(np.float64)  # ~16% positives
+    df = DataFrame({"features": x, "label": y})
+    kw = dict(numIterations=10, numLeaves=8, maxBin=32, baggingFreq=1)
+    # keep all (rare) positives, subsample negatives: still learns
+    m = LightGBMClassifier(posBaggingFraction=0.9999,
+                           negBaggingFraction=0.3, **kw).fit(df)
+    acc = float((m.transform(df)["prediction"] == y).mean())
+    assert acc > 0.9
+    # per-class rates actually differ from plain bagging
+    plain = LightGBMClassifier(baggingFraction=0.5, **kw).fit(df)
+    assert not np.allclose(m.booster.node_value, plain.booster.node_value)
+
+
+def test_extra_trees_randomizes_thresholds(reg_df):
+    df, x, y = reg_df
+    kw = dict(numIterations=10, numLeaves=8, maxBin=64)
+    et = LightGBMRegressor(extraTrees=True, **kw).fit(df)
+    full = LightGBMRegressor(**kw).fit(df)
+    # random single-threshold candidates: different trees, but the
+    # ensemble still learns the signal
+    assert not np.array_equal(et.booster.threshold_bin,
+                              full.booster.threshold_bin)
+    pe = np.asarray(et.transform(df)["prediction"])
+    assert np.corrcoef(pe, y)[0, 1] > 0.85
+    # deterministic under the same seed
+    et2 = LightGBMRegressor(extraTrees=True, **kw).fit(df)
+    np.testing.assert_array_equal(et.booster.threshold_bin,
+                                  et2.booster.threshold_bin)
+
+
+def test_extra_trees_rejected_in_voting_mode(reg_df, mesh8):
+    df, _, _ = reg_df
+    with pytest.raises(NotImplementedError, match="extra_trees"):
+        LightGBMRegressor(extraTrees=True, parallelism="voting_parallel",
+                          numIterations=2, numLeaves=4,
+                          maxBin=16).set_mesh(mesh8).fit(df)

@@ -217,3 +217,81 @@ class TestCognitiveFamilies:
                         [{"faceId": "f1", "faceRectangle": {"top": 1}}],
                         canned_server)
         assert out["f"][0][0]["faceId"] == "f1"
+
+
+class TestAsyncCognitive:
+    """Async long-running-operation protocol (Operation-Location POST +
+    status polling) — the form-recognizer / MVAD pattern."""
+
+    @pytest.fixture()
+    def async_server(self):
+        state = {"polls_until_done": 2, "poll_count": 0,
+                 "final": {"status": "succeeded"}, "bodies": []}
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                state["bodies"].append(json.loads(self.rfile.read(n)))
+                self.send_response(202)
+                host, port = self.server.server_address
+                self.send_header("Operation-Location",
+                                 f"http://{host}:{port}/op/1")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_GET(self):
+                state["poll_count"] += 1
+                if state["poll_count"] <= state["polls_until_done"]:
+                    body = json.dumps({"status": "running"}).encode()
+                else:
+                    body = json.dumps(state["final"]).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        yield f"http://127.0.0.1:{httpd.server_address[1]}/analyze", state
+        httpd.shutdown()
+        httpd.server_close()
+
+    def test_analyze_document_polls_to_completion(self, async_server):
+        from mmlspark_tpu.io.cognitive_services import AnalyzeDocument
+
+        url, state = async_server
+        state["final"] = {"status": "succeeded", "analyzeResult": {
+            "content": "INVOICE #42", "pages": [{}, {}],
+            "keyValuePairs": [{"key": "total", "value": "9.99"}]}}
+        df = DataFrame({"url": np.asarray(["http://x/doc.pdf"], object)})
+        out = AnalyzeDocument(url=url, outputCol="doc",
+                              pollingIntervalSec=0.01).transform(df)
+        assert out["errors"][0] is None
+        assert out["doc"][0]["content"] == "INVOICE #42"
+        assert out["doc"][0]["pages"] == 2
+        assert state["poll_count"] == 3  # 2 running + 1 succeeded
+        assert state["bodies"][0] == {"urlSource": "http://x/doc.pdf"}
+
+    def test_async_failure_and_timeout_surface(self, async_server):
+        from mmlspark_tpu.io.cognitive_services import (
+            AnalyzeDocument, FitMultivariateAnomaly)
+
+        url, state = async_server
+        state["final"] = {"status": "failed", "error": {"code": "boom"}}
+        df = DataFrame({"url": np.asarray(["http://x/doc.pdf"], object)})
+        out = AnalyzeDocument(url=url, outputCol="doc",
+                              pollingIntervalSec=0.01).transform(df)
+        assert out["doc"][0] is None
+        assert "operation failed" in out["errors"][0]
+
+        state.update(polls_until_done=10**6, poll_count=0)
+        sdf = DataFrame({"source": np.asarray(["wasb://data"], object)})
+        out = FitMultivariateAnomaly(
+            url=url, outputCol="m", pollingIntervalSec=0.001,
+            maxPollRetries=3).transform(sdf)
+        assert "did not complete" in out["errors"][0]

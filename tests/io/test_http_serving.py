@@ -298,3 +298,23 @@ class TestDistributedServing:
                 results = list(ex.map(call_one, range(16)))
         for i, out in results:
             assert out["prediction"] == pytest.approx(expected[i], rel=1e-5)
+
+
+def test_fleet_client_failover(rng):
+    """FleetClient retries a dead worker's request on live workers
+    (serving-path fault tolerance, FaultToleranceUtils analog)."""
+    from mmlspark_tpu.core.pipeline import Transformer
+    from mmlspark_tpu.io.serving import FleetClient, ServingFleet
+
+    class _Double(Transformer):
+        def _transform(self, df):
+            return df.with_column("doubled",
+                                  np.asarray(df.col("x")) * 2.0)
+
+    with ServingFleet(_Double(), num_servers=3, max_latency_ms=5) as fleet:
+        client = FleetClient(fleet.registry_url, timeout=5.0)
+        assert len(client.refresh()) == 3
+        # kill one worker; round-robin requests must still all succeed
+        fleet.servers[1].stop()
+        outs = [client.score({"x": float(i)}) for i in range(9)]
+        assert [o["doubled"] for o in outs] == [2.0 * i for i in range(9)]
