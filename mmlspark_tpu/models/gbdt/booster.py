@@ -89,25 +89,49 @@ class BoosterArrays:
     def _go_left_fn(self):
         """Shared per-step routing: (tree_idx, node, fx) -> bool (N,).
 
-        Numerical nodes: NaN or value <= threshold goes left. Categorical
-        nodes (decision_type bit 0): integral value whose bit is set in
-        the node's value bitset goes left; NaN / non-integral / unseen
-        values go right (LightGBM's unseen-category rule)."""
+        Numerical nodes follow LightGBM's decision_type bits: bit 1 is
+        default-left (where missing values go), bits 2-3 the missing
+        type (0 = none: NaN converts to 0.0 and compares; 1 = zeros and
+        NaN are missing; 2 = NaN is missing). Boosters trained without
+        categorical features carry no decision_type (NaN routes left,
+        matching training where the missing bin satisfies
+        bin <= threshold); cat-bearing trained boosters stamp numerical
+        splits with 10 (default-left, NaN missing), and imported model
+        strings honor
+        whatever bits they carry. Categorical nodes (bit 0): integral
+        value whose bit is set in the node's value bitset goes left;
+        NaN / non-integral / unseen values go right (LightGBM's
+        unseen-category rule)."""
         import jax.numpy as jnp
 
         tv = jnp.asarray(self.threshold_value)
-        if not self.has_categorical:
+        dt_np = self.decision_type
+
+        if dt_np is None:
             def go_left(tree_idx, node, fx):
                 return jnp.isnan(fx) | (fx <= tv[tree_idx][node])
             return go_left
 
-        dt = jnp.asarray(self.decision_type)
-        bs = jnp.asarray(self.cat_bitset)
-        w = int(self.cat_bitset.shape[2])
+        dt = jnp.asarray(dt_np)
+        has_cat = self.has_categorical
+        if has_cat:
+            bs = jnp.asarray(self.cat_bitset)
+            w = int(self.cat_bitset.shape[2])
 
         def go_left(tree_idx, node, fx):
-            is_cat = (dt[tree_idx][node] & 1) == 1
-            num_left = jnp.isnan(fx) | (fx <= tv[tree_idx][node])
+            d = dt[tree_idx][node]
+            default_left = (d & 2) != 0
+            mt = (d >> 2) & 3
+            # missing_type none (0): NaN converts to 0.0 and compares;
+            # zero (1): 0.0 and NaN are missing; nan (2): NaN is missing
+            fx0 = jnp.where(jnp.isnan(fx), 0.0, fx)
+            missing = jnp.where(mt == 2, jnp.isnan(fx),
+                                (mt == 1) & (fx0 == 0.0))
+            num_left = jnp.where(missing, default_left,
+                                 fx0 <= tv[tree_idx][node])
+            if not has_cat:
+                return num_left
+            is_cat = (d & 1) == 1
             safe = jnp.where(jnp.isnan(fx), -1.0, fx)
             valid = (safe >= 0) & (safe < w * 32) & (safe == jnp.floor(safe))
             vi = jnp.clip(safe, 0, w * 32 - 1).astype(jnp.int32)
@@ -294,7 +318,8 @@ class BoosterArrays:
         sf, tb, tv, nv, cnt = (self.split_feature[t], self.threshold_bin[t],
                                self.threshold_value[t], self.node_value[t],
                                self.count[t])
-        dt = (self.decision_type[t] if self.decision_type is not None
+        dt_known = self.decision_type is not None
+        dt = (self.decision_type[t] if dt_known
               else np.zeros_like(sf, dtype=np.int8))
         # map full-layout slots to LightGBM internal/leaf numbering (BFS)
         internal_ids: Dict[int, int] = {}
@@ -331,7 +356,10 @@ class BoosterArrays:
                 decision.append(1)
             else:
                 threshold.append(float(tv[m]))
-                decision.append(2)  # default-left: our NaN routes left
+                # preserve imported bits exactly; pre-decision_type
+                # boosters export 10 (default-left + NaN-missing:
+                # training routes the missing bin left)
+                decision.append(int(dt[m]) if dt_known else 10)
             left.append(child_code(2 * m + 1))
             right.append(child_code(2 * m + 2))
             internal_value.append(float(nv[m]))
@@ -424,7 +452,9 @@ class BoosterArrays:
                 max_words = max(max_words,
                                 max(bounds[i + 1] - bounds[i]
                                     for i in range(len(bounds) - 1)))
-        dt = np.zeros((n_trees, m_slots), np.int8) if max_words else None
+        # decision_type is kept for every imported model: numerical
+        # nodes need their default-left / missing-type bits at predict
+        dt = np.zeros((n_trees, m_slots), np.int8)
         bitset = (np.zeros((n_trees, m_slots, max_words), np.uint32)
                   if max_words else None)
         for t, blk in enumerate(tree_blocks):
@@ -463,10 +493,10 @@ class BoosterArrays:
                     cnt[t, slot] = leaf_count[leaf] if leaf < len(leaf_count) else 0
                     return
                 sf[t, slot] = split_feature[code]
+                dt[t, slot] = np.int8(decision[code])
                 if decision[code] & 1:
                     cat_idx = int(threshold[code])
                     lo, hi = cat_bounds[cat_idx], cat_bounds[cat_idx + 1]
-                    dt[t, slot] = 1
                     tv[t, slot] = np.nan
                     bitset[t, slot, :hi - lo] = np.asarray(
                         cat_words[lo:hi], dtype=np.int64).astype(np.uint32)
@@ -508,10 +538,16 @@ class BoosterArrays:
 
         dt = bitset = None
         if a.decision_type is not None or b.decision_type is not None:
+            # a dt-less side's numerical splits behave as default-left
+            # with NaN missing (its training routed NaN left); dt=0
+            # would flip them under the dt-path routing
+            def synth_dt(x):
+                return np.where(x.split_feature >= 0, 10, 0).astype(np.int8)
+
             dt_a = (a.decision_type if a.decision_type is not None
-                    else np.zeros_like(a.split_feature, dtype=np.int8))
+                    else synth_dt(a))
             dt_b = (b.decision_type if b.decision_type is not None
-                    else np.zeros_like(b.split_feature, dtype=np.int8))
+                    else synth_dt(b))
             dt = np.concatenate([pad(dt_a, 0), pad(dt_b, 0)])
             w_a = a.cat_bitset.shape[2] if a.cat_bitset is not None else 1
             w_b = b.cat_bitset.shape[2] if b.cat_bitset is not None else 1

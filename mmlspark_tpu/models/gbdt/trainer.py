@@ -406,8 +406,12 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
                 jnp.where(do_split, best_feat, -1))
             threshold_bin = threshold_bin.at[slots].set(
                 jnp.where(do_split, best_bin, 0))
+            # numerical splits carry default-left + NaN-missing bits
+            # (2 | 8 = 10): training routes the missing bin left, and
+            # loaded models reproduce that routing from the bits
             decision_type = decision_type.at[slots].set(
-                jnp.where(chosen_cat, 1, 0).astype(jnp.int8))
+                jnp.where(do_split,
+                          jnp.where(chosen_cat, 1, 10), 0).astype(jnp.int8))
             bin_go_left = bin_go_left.at[slots].set(
                 left_mask & do_split[:, None])
 

@@ -65,9 +65,10 @@ class TestCategoricalSplits:
         # cat nodes split on feature 0 and carry a nonempty bitset
         assert (b.split_feature[cat_nodes] == 0).all()
         assert (b.cat_bitset[cat_nodes] != 0).any(axis=-1).all()
-        # numerical nodes on feature 1 never flagged
+        # numerical splits carry default-left + NaN-missing bits (10),
+        # never the cat bit
         num_nodes = (b.split_feature == 1)
-        assert (b.decision_type[num_nodes] == 0).all()
+        assert (b.decision_type[num_nodes] == 10).all()
 
     def test_binned_and_raw_prediction_agree(self):
         x, y, _ = _cat_dataset(n=1500)

@@ -74,7 +74,12 @@ def _walk(tree, x):
                           and (words[lo + iv // 32] >> (iv % 32)) & 1)
                 code = left[code] if in_set else right[code]
             else:
-                go_left = np.isnan(v) or v <= thr[code]
+                d = dec[code]
+                mt = (d >> 2) & 3
+                v0 = 0.0 if np.isnan(v) else v
+                missing = (np.isnan(v) if mt == 2
+                           else (mt == 1 and v0 == 0.0))
+                go_left = bool(d & 2) if missing else v0 <= thr[code]
                 code = left[code] if go_left else right[code]
         out[i] += leaf_value[~code]
     return out
@@ -217,3 +222,47 @@ def test_diabetes_l2_matches_sklearn_hgb():
     # energyefficiency L2 rows in BASELINE.md carry +-1.0 on values ~4;
     # the same relative slack vs the measured comparator
     assert ours <= theirs * 1.25, (ours, theirs)
+
+
+def test_decision_type_missing_bits_honored():
+    """Imported numerical decision_type bits: bit 1 default-left, bits
+    2-3 missing type (1 = zeros are missing)."""
+    text = "\n".join([
+        "tree", "version=v4", "num_class=1", "num_tree_per_iteration=1",
+        "label_index=0", "max_feature_idx=0", "objective=regression",
+        "feature_names=f0", "feature_infos=none", "",
+        "Tree=0", "num_leaves=2", "num_cat=0",
+        "split_feature=0", "split_gain=1", "threshold=0.5",
+        "decision_type=0",  # default RIGHT for missing
+        "left_child=-1", "right_child=-2",
+        "leaf_value=1.0 2.0", "leaf_weight=0 0", "leaf_count=1 1",
+        "internal_value=0", "internal_weight=0", "internal_count=2",
+        "is_linear=0", "shrinkage=1", "",
+        "Tree=1", "num_leaves=2", "num_cat=0",
+        "split_feature=0", "split_gain=1", "threshold=0.5",
+        "decision_type=6",  # default left + zeros-are-missing
+        "left_child=-1", "right_child=-2",
+        "leaf_value=10.0 20.0", "leaf_weight=0 0", "leaf_count=1 1",
+        "internal_value=0", "internal_weight=0", "internal_count=2",
+        "is_linear=0", "shrinkage=1", "",
+        "end of trees", "",
+    ])
+    b = BoosterArrays.load_model_string(text)
+    pred = np.asarray(b.predict_jit()(
+        np.array([[0.2], [0.8], [np.nan], [0.0]])))
+    # tree0 (missing_type none): NaN converts to 0.0 <= 0.5 -> left (1);
+    # 0.2->1, 0.8->2, 0.0->1. tree1 (default left, zeros+NaN missing):
+    # 0.2->10, 0.8->20, NaN -> missing -> left (10), 0.0 -> missing -> 10.
+    np.testing.assert_allclose(pred, [11.0, 22.0, 11.0, 11.0])
+    # re-saving preserves the imported bits
+    b2 = BoosterArrays.load_model_string(b.save_model_string())
+    np.testing.assert_allclose(
+        np.asarray(b2.predict_jit()(np.array([[np.nan], [0.0]]))),
+        [11.0, 11.0])
+    # a default-RIGHT NaN-missing node (decision_type = 8 | 0 = missing
+    # nan, default right) routes NaN right
+    text3 = text.replace("decision_type=0", "decision_type=8")
+    b3 = BoosterArrays.load_model_string(text3)
+    np.testing.assert_allclose(
+        np.asarray(b3.predict_jit()(np.array([[np.nan], [0.2]]))),
+        [12.0, 11.0])
