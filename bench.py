@@ -17,14 +17,82 @@ retired per the round-2 verdict.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 BASELINE_MROW_TREES_S = 3.263  # measured: sklearn HistGBDT, this host
 
+# Exit codes: 0 = number produced; 75 (EX_TEMPFAIL) = backend
+# unreachable after bounded retry (tunnel down — not a bench bug);
+# anything else = bench crashed. Rounds 1 and 3 lost their single most
+# valuable artifact to an unretried get_backend hang; the probe runs in
+# a subprocess so a hang is timeout-killable.
+EX_BACKEND_UNREACHABLE = 75
+
+# The image's sitecustomize force-registers the axon platform over any
+# JAX_PLATFORMS env value; only jax.config.update can override it, so
+# the probe (and main) honor BENCH_PLATFORM via config, not env.
+_PROBE = ("import os, jax; p = os.environ.get('BENCH_PLATFORM'); "
+          "p and jax.config.update('jax_platforms', p); "
+          "d = jax.devices(); print(d[0].platform, len(d), flush=True)")
+
+
+def _apply_platform_override():
+    p = os.environ.get("BENCH_PLATFORM")
+    if p:
+        import jax
+        jax.config.update("jax_platforms", p)
+
+
+def probe_backend(attempt_timeout=90.0):
+    """One subprocess backend-init probe (hang-safe). Returns
+    (ok, detail): detail is 'platform ndevices' on success, else the
+    error tail. Shared by the bench scripts and tools/tpu_poll.py."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE], capture_output=True,
+            text=True, timeout=attempt_timeout, env=dict(os.environ))
+        if out.returncode == 0 and out.stdout.strip():
+            return True, out.stdout.strip()
+        return False, (out.stdout + out.stderr).strip()[-300:]
+    except subprocess.TimeoutExpired:
+        return False, f"backend init hang (> {attempt_timeout}s)"
+
+
+def wait_for_backend(attempt_timeout=90.0, backoffs=(15, 30, 60, 120, 240),
+                     metric="gbdt_fit_throughput_higgs28f_2M",
+                     unit="Mrow-trees/s"):
+    """Probe backend init in a subprocess with bounded retry/backoff,
+    then apply the BENCH_PLATFORM override to THIS process so the main
+    workload initializes the same backend the probe validated.
+
+    Returns the probed platform string, or exits EX_BACKEND_UNREACHABLE
+    with a diagnostic JSON line if every attempt hangs or errors.
+    """
+    last = ""
+    for i, pause in enumerate((0,) + tuple(backoffs)):
+        if pause:
+            time.sleep(pause)
+        ok, detail = probe_backend(attempt_timeout)
+        if ok:
+            _apply_platform_override()
+            return detail.split()[0]
+        last = detail
+        print(json.dumps({"probe_attempt": i, "error": last}),
+              file=sys.stderr, flush=True)
+    print(json.dumps({
+        "metric": metric, "value": None, "unit": unit,
+        "vs_baseline": None, "error": f"backend unreachable: {last}"}))
+    sys.exit(EX_BACKEND_UNREACHABLE)
+
 
 def main():
+    platform = wait_for_backend()
+    print(f"# backend up: {platform}", file=sys.stderr, flush=True)
     from mmlspark_tpu.core.compile_cache import enable_persistent_cache
     from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
     from mmlspark_tpu.ops.binning import BinMapper

@@ -22,6 +22,9 @@ import numpy as np
 
 
 def main():
+    if "--cpu" not in sys.argv:
+        from bench import wait_for_backend
+        wait_for_backend(metric="gbdt_hist_level", unit="s/level")
     import jax
     if "--cpu" in sys.argv:
         jax.config.update("jax_platforms", "cpu")
@@ -82,9 +85,20 @@ def main():
         ], axis=-1)
         return jnp.zeros((width * f * b, 3), jnp.float32).at[idx].add(data)
 
+    def variant_pallas():
+        from mmlspark_tpu.models.gbdt.hist_pallas import (
+            pallas_level_histogram,
+        )
+        return pallas_level_histogram(binned, grad, hess, live, local,
+                                      width, f, b)
+
     variants = {"stacked": variant_stacked, "separate": variant_separate,
                 "per_feature": variant_per_feature,
-                "scatter": variant_scatter}
+                "scatter": variant_scatter,
+                "pallas": variant_pallas}
+    if jax.default_backend() != "tpu":
+        # interpret-mode pallas at bench scale is not a measurement
+        variants.pop("pallas")
     results = {}
     for name, fn in variants.items():
         jitted = jax.jit(fn)

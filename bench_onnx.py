@@ -125,6 +125,9 @@ def _resnet50_proto(rng):
 
 
 def main():
+    if "--cpu" not in sys.argv:
+        from bench import wait_for_backend
+        wait_for_backend(metric="onnx_resnet50_scoring", unit="img/s")
     import jax
     if "--cpu" in sys.argv:
         jax.config.update("jax_platforms", "cpu")

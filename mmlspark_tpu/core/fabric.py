@@ -69,6 +69,7 @@ class FabricClient:
         self.tokens = tokens or TokenLibrary()
         self.timeout = timeout
         self._threads: List[threading.Thread] = []
+        self._threads_lock = threading.Lock()
 
     def emit(self, event: Dict[str, Any]) -> None:
         def deep_scrub(v):
@@ -87,10 +88,11 @@ class FabricClient:
             SINK.emit({"certifiedEvent": record})
             return
         # prune finished posts so long-lived emitters don't accumulate
-        # dead Thread objects
-        self._threads = [t for t in self._threads if t.is_alive()]
+        # dead Thread objects; concurrent emitters share the list
         t = threading.Thread(target=self._post, args=(record,), daemon=True)
-        self._threads.append(t)
+        with self._threads_lock:
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
         t.start()
 
     def _post(self, record: Dict[str, Any]) -> None:
@@ -108,6 +110,9 @@ class FabricClient:
             logger.debug("certified event post failed: %s", e)
 
     def flush(self, timeout: float = 10.0) -> None:
-        for t in self._threads:
+        with self._threads_lock:
+            pending = list(self._threads)
+        for t in pending:
             t.join(timeout)
-        self._threads = [t for t in self._threads if t.is_alive()]
+        with self._threads_lock:
+            self._threads = [t for t in self._threads if t.is_alive()]

@@ -85,8 +85,9 @@ class TransformerBlock(nn.Module):
     @nn.compact
     def __call__(self, x, mask=None):
         y = nn.LayerNorm()(x)
-        y = nn.SelfAttention(num_heads=self.heads, qkv_features=self.dim,
-                             deterministic=True)(y, mask=mask)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, qkv_features=self.dim,
+            deterministic=True)(y, mask=mask)
         x = x + y
         y = nn.LayerNorm()(x)
         y = nn.Dense(self.dim * 4)(y)

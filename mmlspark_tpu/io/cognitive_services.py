@@ -192,6 +192,10 @@ class _AsyncCognitiveBase(CognitiveServiceTransformer):
                 retry_after = e.headers.get("Retry-After")
                 if retry_after:
                     _time.sleep(min(float(retry_after), 5.0))
+            except OSError as e:  # URLError/timeouts/conn resets
+                # connection resets / momentary network blips are as
+                # transient as a 503 — same policy as the sync layer
+                last = e
         raise last
 
     def _run_one(self, row):

@@ -19,7 +19,9 @@ TPU-first design: requests are accumulated into micro-batches
 (``maxBatchSize`` rows or ``maxLatencyMs``) and scored as ONE device
 batch — the request/reply correlation the reference keeps in
 HTTPSourceStateHolder (HTTPSourceV2.scala:343) is a local dict of
-request-id -> Event; client-supplied ``"id"`` fields are echoed back.
+request-id -> Event; client-supplied ``"id"`` fields are echoed back,
+unless the served model consumes a column literally named 'id', in
+which case only the reserved ``"__id__"`` key is stripped and echoed.
 """
 
 from __future__ import annotations
@@ -56,6 +58,7 @@ class ServingServer:
                  max_batch_size: int = 64, max_latency_ms: float = 5.0,
                  api_path: str = "/score"):
         self.model = model
+        self._keep_id = self._consumes_id_column(model)
         self.reply_col = reply_col
         self.max_batch_size = max_batch_size
         self.max_latency_ms = max_latency_ms
@@ -154,8 +157,37 @@ class ServingServer:
                     p.error = str(e)
                     p.event.set()
 
+    @staticmethod
+    def _consumes_id_column(m) -> bool:
+        """True when the served model declares a column literally named
+        'id' as an input — in that case 'id' is data, not correlation
+        metadata, and must reach the scoring DataFrame. Clients needing
+        correlation alongside an 'id' feature use the reserved
+        ``__id__`` key, which is always stripped and echoed. Heuristic:
+        covers the framework's input-column param names; models reading
+        'id' through other param names must rely on ``__id__``."""
+        for pname in ("featuresCol", "inputCol", "labelCol"):
+            try:
+                if m.get(pname) == "id":
+                    return True
+            except Exception:
+                pass
+        try:
+            if "id" in (m.get("inputCols") or ()):
+                return True
+        except Exception:
+            pass
+        return False
+
     def _score(self, batch: List[_Pending]):
-        ids = [p.payload.pop("id", None) for p in batch]
+        keep_id = self._keep_id
+        ids = []
+        for p in batch:
+            rid = p.payload.pop("__id__", None)
+            if not keep_id:
+                legacy = p.payload.pop("id", None)
+                rid = rid if rid is not None else legacy
+            ids.append(rid)
         df = DataFrame.from_rows([p.payload for p in batch])
         out = self.model.transform(df)
         reply_cols = [self.reply_col] if self.reply_col else \

@@ -98,10 +98,11 @@ class BoosterArrays:
         bin <= threshold); cat-bearing trained boosters stamp numerical
         splits with 10 (default-left, NaN missing), and imported model
         strings honor
-        whatever bits they carry. Categorical nodes (bit 0): integral
-        value whose bit is set in the node's value bitset goes left;
-        NaN / non-integral / unseen values go right (LightGBM's
-        unseen-category rule)."""
+        whatever bits they carry. Categorical nodes (bit 0): the value is
+        truncated toward zero (LightGBM's static_cast<int>) and goes
+        left iff its bit is set in the node's value bitset; NaN /
+        negative / unseen values go right (LightGBM's unseen-category
+        rule)."""
         import jax.numpy as jnp
 
         tv = jnp.asarray(self.threshold_value)
@@ -132,9 +133,13 @@ class BoosterArrays:
             if not has_cat:
                 return num_left
             is_cat = (d & 1) == 1
+            # LightGBM's CategoricalDecision truncates toward zero
+            # (static_cast<int>), so 3.7 routes as category 3; values
+            # truncating below 0 (and NaN) go right.
             safe = jnp.where(jnp.isnan(fx), -1.0, fx)
-            valid = (safe >= 0) & (safe < w * 32) & (safe == jnp.floor(safe))
-            vi = jnp.clip(safe, 0, w * 32 - 1).astype(jnp.int32)
+            ti = jnp.trunc(safe)
+            valid = (ti >= 0) & (ti < w * 32)
+            vi = jnp.clip(ti, 0, w * 32 - 1).astype(jnp.int32)
             word = bs[tree_idx][node, vi >> 5]
             member = ((word >> (vi & 31).astype(jnp.uint32)) & 1) == 1
             return jnp.where(is_cat, valid & member, num_left)

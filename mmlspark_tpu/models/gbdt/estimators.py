@@ -352,12 +352,36 @@ class _LightGBMBase(Estimator, _LightGBMParams):
             # each; a restarted fit resumes from the latest checkpoint.
             # iteration_offset continues the sampling RNG streams, so an
             # uninterrupted segmented run matches a monolithic one.
+            import json
             import os
             ckpt_dir = self.get("checkpointDir")
             os.makedirs(ckpt_dir, exist_ok=True)
             done = 0
             latest = self._latest_checkpoint(ckpt_dir)
             total = cfg.num_iterations
+            # A checkpoint is only resumable into the run that produced
+            # it: stamp a config/data digest and refuse a mismatched
+            # warm start (a refit with changed params/features/data
+            # would otherwise silently continue an incompatible model).
+            fprint = self._checkpoint_fingerprint(
+                cfg, binned, y, w, mapper.bin_upper_values(cfg.max_bin))
+            meta_path = os.path.join(ckpt_dir, "checkpoint_meta.json")
+            if latest is not None and os.path.exists(meta_path):
+                with open(meta_path) as fh:
+                    stored = json.load(fh).get("fingerprint")
+                if stored != fprint:
+                    raise ValueError(
+                        f"checkpoints in {ckpt_dir} were produced by a "
+                        "different config or dataset (fingerprint "
+                        f"{stored!r} != {fprint!r}); clear the "
+                        "directory to train fresh")
+            else:
+                # fresh dir, or a pre-fingerprint checkpoint dir:
+                # absence is not evidence of mismatch — backfill
+                tmp = meta_path + ".tmp"
+                with open(tmp, "w") as fh:
+                    json.dump({"fingerprint": fprint}, fh)
+                os.replace(tmp, meta_path)
             if latest is not None:
                 done, path = latest
                 if done > total:
@@ -397,6 +421,34 @@ class _LightGBMBase(Estimator, _LightGBMParams):
                 else [init_scores(init_model, vx_raw)],
                 mesh=self._mesh, measures=measures)
         return result, mapper, measures
+
+    @staticmethod
+    def _checkpoint_fingerprint(cfg, binned, y, w, bin_upper):
+        """Digest of everything a warm start must agree on.
+
+        ``num_iterations`` is deliberately excluded: resuming with a
+        raised iteration budget is the supported elastic-restart path
+        (guarded separately by the done>total check).
+        """
+        import hashlib
+        from dataclasses import asdict
+
+        cfg_items = {k: v for k, v in sorted(asdict(cfg).items())
+                     if k != "num_iterations"}
+        h = hashlib.sha256(repr(cfg_items).encode())
+        h.update(repr(binned.shape).encode())
+        # cheap data digest: corner slices + moments, not a full pass
+        head = np.ascontiguousarray(binned[:64])
+        tail = np.ascontiguousarray(binned[-64:])
+        h.update(head.tobytes())
+        h.update(tail.tobytes())
+        # binned codes are scale-invariant (quantile bins move with the
+        # data); the bin boundaries anchor the digest to the raw values
+        h.update(np.ascontiguousarray(bin_upper, np.float64).tobytes())
+        h.update(np.asarray(
+            [float(np.sum(y)), float(len(y)),
+             0.0 if w is None else float(np.sum(w))]).tobytes())
+        return h.hexdigest()[:16]
 
     @staticmethod
     def _latest_checkpoint(ckpt_dir):

@@ -86,7 +86,7 @@ def make_build_tree_voting(num_features: int, total_bins: int, cfg,
     remaining_leaves) with ROW-SHARDED binned/grad/hess/valid."""
     import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     depth = cfg.effective_depth
@@ -210,7 +210,7 @@ def make_build_tree_feature_parallel(num_features: int, total_bins: int,
     feat_mask are FEATURE-SHARDED, rows replicated."""
     import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     depth = cfg.effective_depth

@@ -173,6 +173,17 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
     import jax
     import jax.numpy as jnp
 
+    from mmlspark_tpu.models.gbdt.hist_pallas import (
+        pallas_histogram_enabled,
+        pallas_level_histogram,
+    )
+
+    if pallas_histogram_enabled() and not in_shard_map and b <= 256:
+        # opt-in Pallas kernel (hist_pallas.py; bench_hist.py measures
+        # it against the XLA formulations below on each backend)
+        return pallas_level_histogram(binned, grad, hess, live, local,
+                                      width, f, b)
+
     if jax.default_backend() == "cpu" and not in_shard_map:
         data = jnp.stack([grad * live, hess * live, live], axis=-1)
 

@@ -233,7 +233,7 @@ class _VWBaseLearner(Estimator, _VWParams):
             # stream, weights are pmean-averaged at the pass boundary —
             # the VW spanning-tree allreduce analog
             # (VowpalWabbitSyncSchedule.scala:15-72)
-            from jax.experimental.shard_map import shard_map
+            from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
             from mmlspark_tpu.parallel.mesh import DATA_AXIS, axis_size
@@ -250,7 +250,7 @@ class _VWBaseLearner(Estimator, _VWParams):
             def sharded_pass(w, g2, bias, t, bi, bv, byy, bw):
                 # mark the replicated carry as device-varying so the scan
                 # carry type stays consistent once batch data flows in
-                w, g2, bias, t = jax.lax.pvary((w, g2, bias, t), DATA_AXIS)
+                w, g2, bias, t = jax.lax.pcast((w, g2, bias, t), DATA_AXIS, to='varying')
                 w, g2, bias, t, preds = run(w, g2, bias, t, bi, bv, byy, bw)
                 w = jax.lax.pmean(w, DATA_AXIS)
                 g2 = jax.lax.pmean(g2, DATA_AXIS)

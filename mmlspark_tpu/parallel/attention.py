@@ -120,7 +120,7 @@ def ring_attention(q, k, v, mesh, causal: bool = False,
     """
     import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = q.shape[1]
@@ -172,7 +172,7 @@ def ulysses_attention(q, k, v, mesh, causal: bool = False,
     """
     import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     b, n, h, d = q.shape

@@ -125,6 +125,18 @@ class TestCategoricalSplits:
         assert np.isfinite(p).all()
         assert p[0] == pytest.approx(p[3], abs=1e-6)  # 999 ≡ NaN (both right)
 
+    def test_fractional_category_truncates_like_lightgbm(self):
+        """LightGBM's CategoricalDecision does static_cast<int>(fval):
+        3.7 scores as category 3, not as unseen (ADVICE r3)."""
+        x, y, _ = _cat_dataset(n=1500)
+        res, _ = _fit(x, y, categorical=True, num_iterations=5)
+        predict = res.booster.predict_jit()
+        base = np.asarray(predict(x[:8]))
+        x_frac = x[:8].copy()
+        x_frac[:, 0] = np.trunc(x_frac[:, 0]) + 0.7
+        np.testing.assert_allclose(np.asarray(predict(x_frac)), base,
+                                   rtol=1e-6, atol=1e-6)
+
     def test_onehot_mode_low_cardinality(self):
         rng = np.random.default_rng(3)
         n = 2000

@@ -29,10 +29,12 @@ def test_checkpointed_fit_matches_monolithic(reg_df, tmp_path):
     np.testing.assert_allclose(
         np.asarray(mono.transform(df)["prediction"]),
         np.asarray(ck.transform(df)["prediction"]), atol=1e-5)
-    # checkpoints at 5, 10, 12 exist
-    names = sorted(os.listdir(tmp_path / "ck"))
+    # checkpoints at 5, 10, 12 exist (plus the fingerprint sidecar)
+    names = sorted(n for n in os.listdir(tmp_path / "ck")
+                   if n.endswith(".txt"))
     assert names == ["checkpoint_10.txt", "checkpoint_12.txt",
                      "checkpoint_5.txt"]
+    assert (tmp_path / "ck" / "checkpoint_meta.json").exists()
 
 
 def test_elastic_restart_resumes_from_checkpoint(reg_df, tmp_path):
@@ -53,6 +55,26 @@ def test_elastic_restart_resumes_from_checkpoint(reg_df, tmp_path):
     np.testing.assert_allclose(
         np.asarray(resumed.transform(df)["prediction"]),
         np.asarray(fresh.transform(df)["prediction"]), atol=1e-5)
+
+
+def test_resume_refuses_mismatched_config(reg_df, tmp_path):
+    """A refit with changed params or data must not warm-start from an
+    incompatible checkpoint (ADVICE r3: config/data fingerprint)."""
+    df, x, y = reg_df
+    ckdir = str(tmp_path / "ck")
+    kw = dict(numIterations=8, numLeaves=8, maxBin=32,
+              checkpointDir=ckdir, checkpointInterval=4)
+    LightGBMRegressor(**kw).fit(df)
+    # changed hyperparams -> refuse
+    with pytest.raises(ValueError, match="different config or dataset"):
+        LightGBMRegressor(**{**kw, "numLeaves": 16}).fit(df)
+    # changed data -> refuse
+    df2 = DataFrame({"features": x + 1.0, "label": y})
+    with pytest.raises(ValueError, match="different config or dataset"):
+        LightGBMRegressor(**kw).fit(df2)
+    # raised iteration budget with same config/data -> allowed
+    more = LightGBMRegressor(**{**kw, "numIterations": 12}).fit(df)
+    assert more.booster.num_trees == 12
 
 
 def test_checkpointed_fit_with_sampling_matches(reg_df, tmp_path):

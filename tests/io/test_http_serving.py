@@ -5,6 +5,7 @@ endpoints, SURVEY.md §4.5)."""
 import json
 import threading
 import time
+import urllib.request as urllib_request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -149,6 +150,28 @@ class _DoubleModel(Transformer):
 
 
 class TestServing:
+    def test_model_consuming_id_column(self):
+        """A model whose input column is literally named 'id' still gets
+        that field as data; correlation uses the reserved __id__ key
+        (ADVICE r3)."""
+        from mmlspark_tpu.core.param import HasInputCol
+
+        class _IdModel(Transformer, HasInputCol):
+            def _transform(self, df):
+                col = np.asarray(df.col(self.get("inputCol")), np.float64)
+                return df.with_column("doubled", col * 2.0)
+
+        with ServingServer(_IdModel(inputCol="id"),
+                           max_latency_ms=5) as server:
+            req = urllib_request.Request(
+                server.url,
+                data=json.dumps({"id": 21.0, "__id__": "r-1"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib_request.urlopen(req, timeout=10) as r:
+                out = json.loads(r.read())
+        assert out["doubled"] == 42.0
+        assert out["id"] == "r-1"
+
     def test_serve_scores_and_batches(self):
         import urllib.request
 

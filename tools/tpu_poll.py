@@ -1,0 +1,36 @@
+"""Poll the axon TPU backend until it answers; log status to tpu_poll.log.
+
+Round 1/3 lost their bench numbers to a down tunnel. This poller runs in
+the background, attempts a backend init in a subprocess (so a hang can't
+wedge the poller), and writes ``TPU_UP`` to ``tools/tpu_status`` the
+moment a device responds, plus a timestamped line per attempt.
+"""
+
+import datetime
+import pathlib
+import sys
+import time
+
+HERE = pathlib.Path(__file__).resolve().parent
+LOG = HERE / "tpu_poll.log"
+STATUS = HERE / "tpu_status"
+
+sys.path.insert(0, str(HERE.parent))
+from bench import probe_backend  # noqa: E402  (single shared probe)
+
+
+def main() -> None:
+    interval = float(sys.argv[1]) if len(sys.argv) > 1 else 600.0
+    while True:
+        up, detail = probe_backend()
+        stamp = datetime.datetime.now().isoformat(timespec="seconds")
+        with LOG.open("a") as f:
+            f.write(f"{stamp} {'UP' if up else 'down'} {detail}\n")
+        if up:
+            STATUS.write_text(f"TPU_UP {stamp} {detail}\n")
+            return
+        time.sleep(interval)
+
+
+if __name__ == "__main__":
+    main()
