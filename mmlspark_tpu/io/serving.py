@@ -166,7 +166,7 @@ class ServingServer:
         ``__id__`` key, which is always stripped and echoed. Heuristic:
         covers the framework's input-column param names; models reading
         'id' through other param names must rely on ``__id__``."""
-        for pname in ("featuresCol", "inputCol", "labelCol"):
+        for pname in ("featuresCol", "inputCol"):
             try:
                 if m.get(pname) == "id":
                     return True

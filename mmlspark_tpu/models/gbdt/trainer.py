@@ -622,8 +622,15 @@ def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
         raise NotImplementedError(
             "extra_trees is implemented for the serial/data tree "
             "learners — use tree_learner='data'")
-    return _cache_put(_BUILDER_CACHE, (num_f, total_bins, cfg, mode, mesh),
-                      build)
+    from mmlspark_tpu.models.gbdt.hist_pallas import (
+        pallas_histogram_enabled,
+    )
+    # the histogram backend is chosen at trace time, so it must key the
+    # compiled-builder cache or flipping the env flag is silently ignored
+    return _cache_put(
+        _BUILDER_CACHE,
+        (num_f, total_bins, cfg, mode, mesh, pallas_histogram_enabled()),
+        build)
 
 
 def _resolve_metrics(cfg: TrainConfig):
@@ -809,8 +816,13 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
 
 
 def _get_step_fn(num_f, total_bins, cfg, k, n_valid, mode, mesh):
+    from mmlspark_tpu.models.gbdt.hist_pallas import (
+        pallas_histogram_enabled,
+    )
+
     cfg = _loop_only_normalized(cfg)
-    key = (num_f, total_bins, cfg, k, n_valid, mode, mesh)
+    key = (num_f, total_bins, cfg, k, n_valid, mode, mesh,
+           pallas_histogram_enabled())
     return _cache_put(_CHUNK_CACHE, key,
                       lambda: _make_step_fn(num_f, total_bins, cfg, k,
                                             n_valid, mode, mesh))

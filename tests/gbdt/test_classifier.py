@@ -98,7 +98,7 @@ def test_feature_importances_and_leaf_and_contrib_cols():
     assert np.asarray(out["leaves"]).shape == (test_df.num_rows, 10)
     contribs = np.asarray(out["contribs"])
     assert contribs.shape == (test_df.num_rows, 31)
-    # contributions sum to raw margin (Saabas property)
+    # contributions sum to raw margin (SHAP efficiency property)
     raw = np.asarray(out["rawPrediction"])[:, 1]
     assert np.allclose(contribs.sum(axis=1), raw, atol=1e-3)
 

@@ -103,7 +103,19 @@ def test_trainer_env_flag_routes_to_pallas(monkeypatch):
     bu = mapper.bin_upper_values(32)
     base = train(binned, y, cfg, bin_upper=bu)
     monkeypatch.setenv("MMLSPARK_TPU_PALLAS_HIST", "1")
+    # count actual kernel entries: the flag keys the compiled-step
+    # cache, so the second train must re-trace through the pallas path
+    import mmlspark_tpu.models.gbdt.hist_pallas as hp
+    calls = {"n": 0}
+    orig = hp.pallas_level_histogram
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(hp, "pallas_level_histogram", counting)
     swapped = train(binned, y, cfg, bin_upper=bu)
+    assert calls["n"] > 0, "flag did not route through the pallas kernel"
     p0 = np.asarray(base.booster.predict_jit()(x))
     p1 = np.asarray(swapped.booster.predict_jit()(x))
     np.testing.assert_allclose(p0, p1, rtol=1e-4, atol=1e-4)
