@@ -48,6 +48,56 @@ from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
 from mmlspark_tpu.ops.binning import BinMapper
 
 
+def _cust(stage) -> Optional[Any]:
+    """The stage's custom objective callable, if set (fobj param)."""
+    return stage.get("fobj") if stage.is_set("fobj") else None
+
+
+def _apply_pass_through(cfg: TrainConfig, args: Optional[str]) -> TrainConfig:
+    """Apply LightGBM-style ``key=value`` overrides onto the config
+    (the reference's passThroughArgs escape hatch, LightGBMParams
+    OtherParams group). Keys are TrainConfig field names, which match
+    LightGBM's snake_case option names; unknown keys raise rather than
+    silently vanish."""
+    if not args:
+        return cfg
+    import dataclasses
+    fields = {f.name: f for f in dataclasses.fields(TrainConfig)}
+    updates: Dict[str, Any] = {}
+    for tok in args.split():
+        if "=" not in tok:
+            raise ValueError(f"passThroughArgs entry {tok!r} is not "
+                             "key=value")
+        key, val = tok.split("=", 1)
+        if key not in fields:
+            raise ValueError(
+                f"passThroughArgs: {key!r} is not a training option "
+                "this engine knows (see PARAMS.md for the parity table)")
+        updates[key] = _parse_arg_value(val)
+    return replace(cfg, **updates)
+
+
+def _parse_arg_value(val: str) -> Any:
+    """LightGBM-style literal: bool / int / float / comma list / str.
+    Value-driven (not keyed off the field's current value, which may be
+    None or a differently-typed default)."""
+    def scalar(v):
+        low = v.strip().lower()
+        if low in ("true", "+"):
+            return True
+        if low in ("false", "-"):
+            return False
+        for cast in (int, float):
+            try:
+                return cast(v)
+            except ValueError:
+                pass
+        return v
+    if "," in val:
+        return tuple(scalar(v) for v in val.split(",") if v != "")
+    return scalar(val)
+
+
 class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
     """Shared param block (params/LightGBMParams.scala:1 surface)."""
 
@@ -75,6 +125,11 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCo
     baggingFreq = Param("baggingFreq", "re-bag every k iterations", to_int,
                         ge(0), default=0)
     baggingSeed = Param("baggingSeed", "bagging seed", to_int, default=3)
+    featureFractionSeed = Param("featureFractionSeed",
+                                "feature-subsampling seed", to_int,
+                                default=2)
+    extraSeed = Param("extraSeed", "extra_trees threshold seed", to_int,
+                      default=6)
     posBaggingFraction = Param("posBaggingFraction", "bagging rate for "
                                "positive binary rows", to_float,
                                in_range(0, 1, lo_inclusive=False), default=1.0)
@@ -134,6 +189,60 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCo
         "(0 = off; requires checkpointDir)", to_int, ge(0), default=0)
     minDataInBin = Param("minDataInBin", "min sampled rows per feature bin",
                          to_int, gt(0), default=3)
+    maxDrop = Param("maxDrop", "DART: max trees dropped per iteration "
+                    "(<=0 = unlimited)", to_int, default=50)
+    uniformDrop = Param("uniformDrop", "DART: drop trees uniformly instead "
+                        "of weight-proportionally", to_bool, default=False)
+    dropSeed = Param("dropSeed", "DART: seed of the drop-selection RNG "
+                     "stream (default derived from seed)", to_int)
+    featureFractionByNode = Param(
+        "featureFractionByNode", "re-sample the feature subset at every "
+        "tree node (LightGBM feature_fraction_bynode)", to_float,
+        in_range(0, 1, lo_inclusive=False), default=1.0)
+    improvementTolerance = Param(
+        "improvementTolerance", "early stopping: margin an eval score "
+        "must clear to count as improved (TrainUtils.scala:143-169)",
+        to_float, default=0.0)
+    minDataPerGroup = Param(
+        "minDataPerGroup", "min rows per category for the sorted "
+        "categorical scan (LightGBM min_data_per_group)", to_int, gt(0),
+        default=100)
+    initScoreCol = Param(
+        "initScoreCol", "column of per-row initial scores to boost from "
+        "(LightGBM init_score; scores are a training offset and are NOT "
+        "added back at predict, matching LightGBM)", to_str)
+    boostFromAverage = Param(
+        "boostFromAverage", "start boosting from the objective's average "
+        "score instead of 0", to_bool, default=True)
+    deterministic = Param(
+        "deterministic", "deterministic training (always true on this "
+        "engine: device RNG streams are seed-keyed)", to_bool,
+        default=True)
+    monotoneConstraintsMethod = Param(
+        "monotoneConstraintsMethod", "constraint enforcement method; this "
+        "engine implements LightGBM's 'basic'",
+        to_str, one_of("basic"), default="basic")
+    zeroAsMissing = Param(
+        "zeroAsMissing", "treat 0.0 feature values as missing (LightGBM "
+        "zero_as_missing; stamps zero-missing decision bits so scoring "
+        "routes zeros like NaN)", to_bool, default=False)
+    maxBinByFeature = Param(
+        "maxBinByFeature", "per-feature max bin counts overriding maxBin",
+        to_list(to_int))
+    binSampleCount = Param(
+        "binSampleCount", "rows sampled to compute bin boundaries",
+        to_int, gt(0), default=200_000)
+    fobj = Param(
+        "fobj", "custom objective callable (preds, labels, weights) -> "
+        "(grad, hess) (FObjTrait.scala:1 analog)", is_complex=True)
+    isProvideTrainingMetric = Param(
+        "isProvideTrainingMetric", "training metrics are always recorded "
+        "here (train_<metric> series in evals_result); declared for "
+        "parity", to_bool, default=False)
+    passThroughArgs = Param(
+        "passThroughArgs", "space-separated LightGBM-style key=value "
+        "overrides applied onto the training config after the typed "
+        "params (snake_case LightGBM names)", to_str)
     objective = Param("objective", "training objective", to_str)
     metric = Param("metric", "eval metric (default per objective)", to_str)
     modelString = Param("modelString", "warm-start model string", to_str)
@@ -208,6 +317,20 @@ class _LightGBMParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCo
                           "serial": "serial"}[self.get("parallelism")],
             top_k=self.get("topK"),
             seed=self.get("seed"),
+            max_drop=self.get("maxDrop"),
+            uniform_drop=self.get("uniformDrop"),
+            drop_seed=(self.get("dropSeed")
+                       if self.is_set("dropSeed") else None),
+            feature_fraction_by_node=self.get("featureFractionByNode"),
+            improvement_tolerance=self.get("improvementTolerance"),
+            min_data_per_group=self.get("minDataPerGroup"),
+            min_data_in_bin=self.get("minDataInBin"),
+            bagging_seed=self.get("baggingSeed"),
+            feature_fraction_seed=self.get("featureFractionSeed"),
+            extra_seed=self.get("extraSeed"),
+            boost_from_average=self.get("boostFromAverage"),
+            deterministic=self.get("deterministic"),
+            zero_as_missing=self.get("zeroAsMissing"),
             **extra,
         )
 
@@ -268,6 +391,10 @@ class _LightGBMBase(Estimator, _LightGBMParams):
         measures = InstrumentationMeasures()
         train_df, valid_df = self._split_validation(df)
         x, y, w = self._extract(train_df)
+        if self.get("zeroAsMissing"):
+            # LightGBM zero_as_missing: zeros enter the missing bin;
+            # scoring parity comes from the zero-missing decision bits
+            x = np.where(x == 0.0, np.nan, x)
         # group ids must be computed on the *post-split* rows so they
         # stay aligned with binned/y when a validation indicator is set
         group_ids = vgroup_ids = None
@@ -279,34 +406,70 @@ class _LightGBMBase(Estimator, _LightGBMParams):
             group_ids = encode_groups(train_df)
             if valid_df is not None and valid_df.num_rows:
                 vgroup_ids = encode_groups(valid_df)
+        cat = self._categorical_indexes(df)
+        cfg = self._train_config(objective, num_class=num_class,
+                                 categorical_features=cat,
+                                 **(extra_cfg or {}))
+        # pass-through overrides land BEFORE binning/preprocessing so
+        # binning-coupled keys (max_bin, min_data_in_bin,
+        # zero_as_missing) take effect everywhere, not just in training
+        cfg = _apply_pass_through(cfg, self.get("passThroughArgs")
+                                  if self.is_set("passThroughArgs") else None)
+        if cfg.zero_as_missing and not self.get("zeroAsMissing"):
+            x = np.where(x == 0.0, np.nan, x)
         with measures.phase("binning"):
-            cat = self._categorical_indexes(df)
             mapper = BinMapper.fit(
-                _sample_rows(x, self.get("seed")), max_bin=self.get("maxBin"),
+                _sample_rows(x, self.get("seed"),
+                             max_sample=self.get("binSampleCount")),
+                max_bin=cfg.max_bin,
                 categorical_features=cat,
-                min_data_in_bin=self.get("minDataInBin"))
+                min_data_in_bin=cfg.min_data_in_bin,
+                max_bin_by_feature=(self.get("maxBinByFeature")
+                                    if self.is_set("maxBinByFeature")
+                                    else None))
             binned = mapper.transform(x)
         valid_sets = None
         if valid_df is not None and valid_df.num_rows:
             vx, vy, vw = self._extract(valid_df)
+            if cfg.zero_as_missing:
+                vx = np.where(vx == 0.0, np.nan, vx)
             valid_sets = [(mapper.transform(vx), vy, vw, vgroup_ids)]
-        cfg = self._train_config(objective, num_class=num_class,
-                                 categorical_features=cat,
-                                 **(extra_cfg or {}))
         init_model = None
         if self.is_set("modelString"):
             init_model = BoosterArrays.load_model_string(self.get("modelString"))
 
-        def init_scores(model, xs):
+        init0 = vinit0 = None
+        if self.is_set("initScoreCol"):
+            # per-row training offset (LightGBM init_score via
+            # HasInitScoreCol, LightGBMBase.scala:153); must align with
+            # the post-validation-split training rows
+            init0 = np.asarray(train_df.col(self.get("initScoreCol")),
+                               dtype=np.float64)
+            k_out = num_class if num_class > 2 else 1
+            if k_out > 1 and (init0.ndim != 2 or init0.shape[1] != k_out):
+                raise ValueError(
+                    f"initScoreCol {self.get('initScoreCol')!r} must hold "
+                    f"(N, {k_out}) per-class scores for a {k_out}-class "
+                    f"objective; got shape {init0.shape}")
+
+        def init_scores(model, xs, offset=None):
             # raw-space warm-start scores: computed on raw features so a
-            # continued model is valid even under a different binning
-            return None if model is None else np.asarray(
+            # continued model is valid even under a different binning,
+            # plus the optional initScoreCol per-row offset
+            s = None if model is None else np.asarray(
                 model.predict_jit()(xs))
+            if offset is not None:
+                s = offset if s is None else s + offset
+            return s
 
         vx_raw = None
         if valid_sets is not None:
             vx_raw = np.asarray(valid_df.col(self.get("featuresCol")),
                                 dtype=np.float64)
+            if init0 is not None:
+                vinit0 = np.asarray(
+                    valid_df.col(self.get("initScoreCol")),
+                    dtype=np.float64)
 
         num_batches = self.get("numBatches")
         ckpt_every = self.get("checkpointInterval")
@@ -326,10 +489,15 @@ class _LightGBMBase(Estimator, _LightGBMParams):
                     group_ids=None if group_ids is None else group_ids[part],
                     bin_upper=mapper.bin_upper_values(cfg.max_bin),
                     valid_sets=valid_sets, init_model=init_model,
-                    init_raw=init_scores(init_model, x[part]),
-                    valid_init_raws=None if (init_model is None or vx_raw is None)
-                    else [init_scores(init_model, vx_raw)],
-                    mesh=self._mesh, measures=measures)
+                    init_raw=init_scores(
+                        init_model, x[part],
+                        None if init0 is None else init0[part]),
+                    valid_init_raws=None if (
+                        vx_raw is None
+                        or (init_model is None and vinit0 is None))
+                    else [init_scores(init_model, vx_raw, vinit0)],
+                    mesh=self._mesh, measures=measures,
+                    custom_objective=_cust(self))
                 init_model = result.booster
         elif ckpt_every:
             if not self.is_set("checkpointDir"):
@@ -364,7 +532,8 @@ class _LightGBMBase(Estimator, _LightGBMParams):
             # warm start (a refit with changed params/features/data
             # would otherwise silently continue an incompatible model).
             fprint = self._checkpoint_fingerprint(
-                cfg, binned, y, w, mapper.bin_upper_values(cfg.max_bin))
+                cfg, binned, y, w, mapper.bin_upper_values(cfg.max_bin),
+                init0)
             meta_path = os.path.join(ckpt_dir, "checkpoint_meta.json")
             if latest is not None and os.path.exists(meta_path):
                 with open(meta_path) as fh:
@@ -399,10 +568,13 @@ class _LightGBMBase(Estimator, _LightGBMParams):
                     weights=w, group_ids=group_ids,
                     bin_upper=mapper.bin_upper_values(cfg.max_bin),
                     valid_sets=valid_sets, init_model=init_model,
-                    init_raw=init_scores(init_model, x),
-                    valid_init_raws=None if (init_model is None or vx_raw is None)
-                    else [init_scores(init_model, vx_raw)],
+                    init_raw=init_scores(init_model, x, init0),
+                    valid_init_raws=None if (
+                        vx_raw is None
+                        or (init_model is None and vinit0 is None))
+                    else [init_scores(init_model, vx_raw, vinit0)],
                     mesh=self._mesh, measures=measures,
+                    custom_objective=_cust(self),
                     iteration_offset=done)
                 init_model = result.booster
                 done += seg
@@ -416,14 +588,17 @@ class _LightGBMBase(Estimator, _LightGBMParams):
                 binned, y, cfg, weights=w, group_ids=group_ids,
                 bin_upper=mapper.bin_upper_values(cfg.max_bin),
                 valid_sets=valid_sets, init_model=init_model,
-                init_raw=init_scores(init_model, x),
-                valid_init_raws=None if (init_model is None or vx_raw is None)
-                else [init_scores(init_model, vx_raw)],
-                mesh=self._mesh, measures=measures)
+                init_raw=init_scores(init_model, x, init0),
+                valid_init_raws=None if (
+                    vx_raw is None
+                    or (init_model is None and vinit0 is None))
+                else [init_scores(init_model, vx_raw, vinit0)],
+                mesh=self._mesh, measures=measures,
+                custom_objective=_cust(self))
         return result, mapper, measures
 
     @staticmethod
-    def _checkpoint_fingerprint(cfg, binned, y, w, bin_upper):
+    def _checkpoint_fingerprint(cfg, binned, y, w, bin_upper, init0=None):
         """Digest of everything a warm start must agree on.
 
         ``num_iterations`` is deliberately excluded: resuming with a
@@ -447,7 +622,8 @@ class _LightGBMBase(Estimator, _LightGBMParams):
         h.update(np.ascontiguousarray(bin_upper, np.float64).tobytes())
         h.update(np.asarray(
             [float(np.sum(y)), float(len(y)),
-             0.0 if w is None else float(np.sum(w))]).tobytes())
+             0.0 if w is None else float(np.sum(w)),
+             0.0 if init0 is None else float(np.sum(init0))]).tobytes())
         return h.hexdigest()[:16]
 
     @staticmethod
@@ -566,11 +742,22 @@ class LightGBMClassifier(_LightGBMBase):
                        to_list(to_float))
     isUnbalance = Param("isUnbalance", "auto-weight unbalanced binary labels",
                         to_bool, default=False)
+    maxNumClasses = Param("maxNumClasses", "cap on discovered label "
+                          "cardinality", to_int, gt(0), default=100)
+    scalePosWeight = Param(
+        "scalePosWeight", "weight of positive-class rows in the binary "
+        "objective (LightGBM scale_pos_weight; the reference reaches it "
+        "via passThroughArgs)", to_float, gt(0), default=1.0)
 
     def _fit(self, df: DataFrame) -> "LightGBMClassificationModel":
         y_raw = np.asarray(df.col(self.get("labelCol")), dtype=np.float64)
         classes = np.unique(y_raw[~np.isnan(y_raw)])
         num_class = len(classes)
+        if num_class > self.get("maxNumClasses"):
+            raise ValueError(
+                f"{num_class} distinct labels exceeds maxNumClasses="
+                f"{self.get('maxNumClasses')} (guards runaway label "
+                "cardinality, LightGBMClassifier.scala maxNumClasses)")
         objective = self.get("objective") or (
             "binary" if num_class <= 2 else "multiclass")
         if objective == "binary" and num_class > 2:
@@ -578,11 +765,21 @@ class LightGBMClassifier(_LightGBMBase):
         # re-encode labels to 0..K-1 (objectives one-hot by index)
         encoded = np.searchsorted(classes, y_raw).astype(np.float64)
         df = df.with_column(self.get("labelCol"), encoded)
-        if self.get("isUnbalance") and objective == "binary":
-            # scale positive-class rows by neg/pos (LightGBM is_unbalance)
-            pos = max(float((encoded == 1).sum()), 1.0)
-            neg = float((encoded == 0).sum())
-            w = np.where(encoded == 1, neg / pos, 1.0)
+        spw = self.get("scalePosWeight")
+        if ((self.get("isUnbalance") or spw != 1.0)
+                and objective == "binary"):
+            if self.get("isUnbalance") and spw != 1.0:
+                raise ValueError(
+                    "isUnbalance and scalePosWeight are mutually "
+                    "exclusive (LightGBM: set only one)")
+            # scale positive-class rows by neg/pos (LightGBM
+            # is_unbalance) or by the explicit scale_pos_weight —
+            # weighting grad+hess equals row weighting
+            if self.get("isUnbalance"):
+                pos = max(float((encoded == 1).sum()), 1.0)
+                neg = float((encoded == 0).sum())
+                spw = neg / pos
+            w = np.where(encoded == 1, spw, 1.0)
             if self.is_set("weightCol"):
                 w = w * np.asarray(df.col(self.get("weightCol")), np.float64)
                 df = df.with_column(self.get("weightCol"), w)
@@ -711,12 +908,21 @@ class LightGBMRanker(_LightGBMBase):
                      default="group")
     evalAt = Param("evalAt", "NDCG@k eval positions", to_list(to_int),
                    default=[1, 3, 5])
+    labelGain = Param("labelGain", "per-relevance-level NDCG gains "
+                      "(default 2^label - 1)", to_list(to_float))
+    maxPosition = Param("maxPosition", "NDCG truncation level "
+                        "(lambdarank_truncation_level)", to_int, gt(0),
+                        default=30)
 
     def _fit(self, df: DataFrame) -> "LightGBMRankerModel":
         eval_at = self.get("evalAt") or [5]
+        extra = {"eval_at": tuple(int(p) for p in eval_at),
+                 "lambdarank_truncation_level": self.get("maxPosition")}
+        if self.is_set("labelGain"):
+            extra["label_gain"] = tuple(self.get("labelGain"))
         result, mapper, measures = self._fit_booster(
             df, "lambdarank", group_col=self.get("groupCol"),
-            extra_cfg={"eval_at": tuple(int(p) for p in eval_at)})
+            extra_cfg=extra)
         model = LightGBMRankerModel(
             **{k: v for k, v in self._paramMap.items()
                if LightGBMRankerModel.has_param(k)})

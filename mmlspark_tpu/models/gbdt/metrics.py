@@ -100,7 +100,7 @@ def quantile_loss(raw, labels, weights=None, alpha: float = 0.5):
     return jnp.sum(loss * w) / jnp.sum(w)
 
 
-def ndcg_at(k: int):
+def ndcg_at(k: int, label_gain=None):
     def ndcg(raw, labels, weights=None, group_ids=None):
         from mmlspark_tpu.models.gbdt.objectives import group_ranks
 
@@ -109,7 +109,12 @@ def ndcg_at(k: int):
         same = group_ids[:, None] == group_ids[None, :]
         pred_rank = group_ranks(raw, group_ids)
         ideal_rank = group_ranks(labels, group_ids)
-        gain = 2.0 ** labels - 1.0
+        if label_gain is not None:
+            lg = jnp.asarray(label_gain, raw.dtype)
+            gain = lg[jnp.clip(labels.astype(jnp.int32), 0,
+                               lg.shape[0] - 1)]
+        else:
+            gain = 2.0 ** labels - 1.0
         dcg_t = jnp.where(pred_rank < k, gain / jnp.log2(2.0 + pred_rank), 0.0)
         idcg_t = jnp.where(ideal_rank < k, gain / jnp.log2(2.0 + ideal_rank), 0.0)
         samef = same.astype(raw.dtype)

@@ -132,7 +132,8 @@ def group_ranks(scores: Array, group_ids: Array) -> Array:
 
 def lambdarank(preds: Array, labels: Array, weights=None,
                group_ids: Array = None, max_label: int = 31,
-               sigmoid: float = 1.0, truncation_level: int = 30):
+               sigmoid: float = 1.0, truncation_level: int = 30,
+               label_gain=None):
     """LambdaMART gradients with NDCG delta weighting.
 
     The reference delegates this to LightGBM C++ (objective
@@ -144,7 +145,12 @@ def lambdarank(preds: Array, labels: Array, weights=None,
     """
     if group_ids is None:
         raise ValueError("lambdarank requires group_ids")
-    gain = (2.0 ** labels - 1.0)
+    if label_gain is not None:
+        # explicit per-relevance gains (LightGBM label_gain)
+        lg = jnp.asarray(label_gain, preds.dtype)
+        gain = lg[jnp.clip(labels.astype(jnp.int32), 0, lg.shape[0] - 1)]
+    else:
+        gain = (2.0 ** labels - 1.0)
     pred_rank = group_ranks(preds, group_ids)
     label_rank = group_ranks(labels, group_ids)
     disc_pred = 1.0 / jnp.log2(2.0 + pred_rank)
@@ -158,6 +164,10 @@ def lambdarank(preds: Array, labels: Array, weights=None,
     s_diff = preds[:, None] - preds[None, :]
     label_diff = labels[:, None] - labels[None, :]
     valid = (group_ids[:, None] == group_ids[None, :]) & (label_diff > 0)
+    # LightGBM lambdarank truncation: only pairs touching the current
+    # top-k predicted positions carry gradient
+    topk = pred_rank < truncation_level
+    valid = valid & (topk[:, None] | topk[None, :])
     rho = jax.nn.sigmoid(-sigmoid * s_diff)  # P(worse ranked higher)
     delta_ndcg = jnp.abs(
         (gain[:, None] - gain[None, :]) *

@@ -111,12 +111,49 @@ class TrainConfig:
     # LightGBM extra_trees: evaluate ONE random threshold per
     # (node, feature) instead of scanning every bin
     extra_trees: bool = False
+    # DART extras (BaseTrainParams.scala DartModeParams): cap on trees
+    # dropped per iteration (<=0 = unlimited), uniform vs
+    # weight-proportional drop selection, and a dedicated drop RNG
+    # stream (None = derived from seed)
+    max_drop: int = 50
+    uniform_drop: bool = False
+    drop_seed: Optional[int] = None
+    # seed family (LightGBM derives per-purpose streams; defaults match
+    # its conventions: bagging 3, feature_fraction 2, extra 6)
+    bagging_seed: int = 3
+    feature_fraction_seed: int = 2
+    extra_seed: int = 6
+    # lambdarank (RankerTrainParams maxPosition / labelGain)
+    lambdarank_truncation_level: int = 30
+    label_gain: Any = ()
+    # LightGBM zero_as_missing: zeros are binned as missing (the
+    # estimator maps 0.0 -> NaN pre-binning) and trained nodes stamp
+    # zero-missing decision bits so raw scoring routes zeros the same
+    zero_as_missing: bool = False
+    # LightGBM feature_fraction_bynode: re-sample the feature subset at
+    # every tree node instead of once per tree
+    feature_fraction_by_node: float = 1.0
+    # early-stopping improvement tolerance (TrainUtils.scala:143-169:
+    # an eval counts as improved iff cur-best > tol for higher-better
+    # metrics, cur-best < tol for lower-better)
+    improvement_tolerance: float = 0.0
+    # LightGBM min_data_per_group: categories below this count are
+    # excluded from the sorted categorical scan (one-hot mode keeps
+    # its per-bin min_data_in_leaf guard)
+    min_data_per_group: int = 100
+    # LightGBM min_data_in_bin: consumed by BinMapper at fit time (the
+    # trainer itself sees only binned codes); lives here so
+    # passThroughArgs can reach it
+    min_data_in_bin: int = 3
 
     def __post_init__(self):
         # eval_at may arrive as a list; the config is used as a cache key
         # for compiled functions, so every field must be hashable
         if isinstance(self.eval_at, list):
             object.__setattr__(self, "eval_at", tuple(self.eval_at))
+        if isinstance(self.label_gain, (list, np.ndarray)):
+            object.__setattr__(self, "label_gain",
+                               tuple(float(g) for g in self.label_gain))
         if isinstance(self.categorical_features, (list, np.ndarray)):
             object.__setattr__(self, "categorical_features",
                                tuple(int(i) for i in self.categorical_features))
@@ -149,6 +186,13 @@ def _objective_kwargs(cfg: TrainConfig) -> Dict[str, Any]:
         return {"tweedie_variance_power": cfg.tweedie_variance_power}
     if name == "poisson":
         return {"max_delta_step": cfg.poisson_max_delta_step}
+    if name == "lambdarank":
+        kw: Dict[str, Any] = {
+            "sigmoid": cfg.sigmoid,
+            "truncation_level": cfg.lambdarank_truncation_level}
+        if cfg.label_gain:
+            kw["label_gain"] = tuple(cfg.label_gain)
+        return kw
     return {}
 
 
@@ -266,8 +310,10 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
         (bagging/GOSS already folded into grad/hess scaling + this mask);
         feat_mask (F,) f32; remaining_leaves traced int; key seeds the
         extra_trees random thresholds (required when extra_trees)."""
-        if cfg.extra_trees and key is None:
-            raise ValueError("extra_trees needs an rng key")
+        if (cfg.extra_trees or cfg.feature_fraction_by_node < 1.0) \
+                and key is None:
+            raise ValueError("extra_trees / feature_fraction_by_node "
+                             "need an rng key")
         n = binned.shape[0]
         f = num_features
         b = total_bins
@@ -320,7 +366,25 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
             ok = ((cl >= min_child) & (cr >= min_child)
                   & (hl >= min_hess) & (hr >= min_hess)
                   & (gain > min_gain))
-            ok &= feat_mask[None, :, None] > 0
+            # per-tree feature mask, optionally re-sampled per node
+            # (LightGBM feature_fraction_bynode)
+            node_fmask = feat_mask[None, :] > 0         # (1|width, F)
+            if cfg.feature_fraction_by_node < 1.0:
+                # sample per node from the TREE's feature subset (as
+                # LightGBM feature_fraction_bynode composes with
+                # feature_fraction), never leaving a node featureless
+                avail = jnp.sum(feat_mask > 0)
+                keep_n = jnp.maximum(1, jnp.round(
+                    avail * cfg.feature_fraction_by_node)).astype(jnp.int32)
+                kn = jax.random.fold_in(jax.random.fold_in(key, 101), d)
+                draw = jax.random.uniform(kn, (width, num_features))
+                draw = jnp.where(feat_mask[None, :] > 0, draw, -1.0)
+                sortd = jnp.sort(draw, axis=1)[:, ::-1]  # descending
+                kth = jnp.take_along_axis(
+                    sortd, jnp.broadcast_to(keep_n - 1, (width,))[:, None],
+                    axis=1)
+                node_fmask = node_fmask & (draw >= kth)
+            ok &= node_fmask[:, :, None]
             # last bin can't split (right side empty by construction)
             ok &= jnp.arange(b)[None, None, :] < b - 1
             if has_mono:
@@ -339,13 +403,19 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
                 g_b, h_b, c_b = hist[..., 0], hist[..., 1], hist[..., 2]
                 not_missing = jnp.arange(b)[None, None, :] > 0
                 used = (c_b > 0) & not_missing
-                ratio = jnp.where(used, g_b / (h_b + cfg.cat_smooth),
-                                  jnp.inf)
+                # LightGBM min_data_per_group: the sorted scan only
+                # considers categories with enough rows (filtered ones
+                # route right); one-hot mode keeps the plain used set
+                used_sorted = used & (
+                    c_b >= float(max(cfg.min_data_per_group, 1)))
+                ratio = jnp.where(used_sorted,
+                                  g_b / (h_b + cfg.cat_smooth), jnp.inf)
                 sort_idx = jnp.argsort(ratio, axis=2)   # unused sort last
                 shist = jnp.take_along_axis(
                     hist, sort_idx[..., None], axis=2)
                 scum = jnp.cumsum(shist, axis=2)
                 num_used = jnp.sum(used, axis=2)        # (width, F)
+                num_sorted = jnp.sum(used_sorted, axis=2)
                 gl_c, hl_c, cl_c = scum[..., 0], scum[..., 1], scum[..., 2]
                 gr_c, hr_c = gt - gl_c, ht - hl_c
                 cr_c = ct - cl_c
@@ -354,8 +424,8 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
                 _, cscore_p = leaf_objective(gt, ht, cfg.cat_l2)
                 cgain = 0.5 * (cscore_l + cscore_r - cscore_p)
                 pos1 = jnp.arange(1, b + 1)[None, None, :]  # left-set size
-                side = jnp.minimum(pos1, num_used[..., None] - pos1)
-                cok = ((pos1 < num_used[..., None])
+                side = jnp.minimum(pos1, num_sorted[..., None] - pos1)
+                cok = ((pos1 < num_sorted[..., None])
                        & (side <= cfg.max_cat_threshold)
                        & (cl_c >= min_child) & (cr_c >= min_child)
                        & (hl_c >= min_hess) & (hr_c >= min_hess)
@@ -373,7 +443,7 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
                 ogain = jnp.where(ook, ogain, -jnp.inf)
                 onehot = (num_used <= cfg.max_cat_to_onehot)[..., None]
                 cat_gain = jnp.where(onehot, ogain, cgain)
-                cat_gain = jnp.where(feat_mask[None, :, None] > 0,
+                cat_gain = jnp.where(node_fmask[:, :, None],
                                      cat_gain, -jnp.inf)
                 gain = jnp.where(is_cat_f[None, :, None], cat_gain, gain)
 
@@ -400,7 +470,7 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
                 s_idx = sort_idx[sel, best_feat]        # (width, B)
                 # rank of bin id in sorted order = inverse permutation
                 bin_rank = jnp.argsort(s_idx, axis=1)
-                used_sel = used[sel, best_feat]
+                used_sel = used_sorted[sel, best_feat]
                 onehot_sel = num_used[sel, best_feat] <= cfg.max_cat_to_onehot
                 mask_prefix = (bin_rank <= best_bin[:, None]) & used_sel
                 mask_onehot = jnp.arange(b)[None, :] == best_bin[:, None]
@@ -420,9 +490,11 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
             # numerical splits carry default-left + NaN-missing bits
             # (2 | 8 = 10): training routes the missing bin left, and
             # loaded models reproduce that routing from the bits
+            num_bits = 6 if cfg.zero_as_missing else 10
             decision_type = decision_type.at[slots].set(
                 jnp.where(do_split,
-                          jnp.where(chosen_cat, 1, 10), 0).astype(jnp.int8))
+                          jnp.where(chosen_cat, 1, num_bits),
+                          0).astype(jnp.int8))
             bin_go_left = bin_go_left.at[slots].set(
                 left_mask & do_split[:, None])
 
@@ -639,7 +711,9 @@ def _resolve_metrics(cfg: TrainConfig):
     if metric_name == "ndcg":
         positions = cfg.eval_at if isinstance(cfg.eval_at, (list, tuple)) \
             else [cfg.eval_at]
-        metric_list = [(f"ndcg@{p}", metrics_mod.ndcg_at(int(p)))
+        lg = tuple(cfg.label_gain or ()) or None
+        metric_list = [(f"ndcg@{p}",
+                        metrics_mod.ndcg_at(int(p), label_gain=lg))
                        for p in positions]
         higher_better = True
     else:
@@ -681,8 +755,6 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
     predict_tree = _make_predict_tree(depth)
     objective_fn = obj_mod.get_objective(cfg.objective)
     obj_kwargs = _objective_kwargs(cfg)
-    if cfg.objective == "lambdarank":
-        obj_kwargs = {"sigmoid": cfg.sigmoid}
     metric_name, metric_list, _, metric_kwargs = _resolve_metrics(cfg)
     is_rf = cfg.boosting_type == "rf"
     is_goss = cfg.boosting_type == "goss"
@@ -715,8 +787,9 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
                 ref_it = it - (it % freq)
             else:
                 ref_it = 0  # rf with no freq: one fixed bag
-            kbag = jax.random.fold_in(jax.random.fold_in(base_key, 1),
-                                      ref_it)
+            kbag = jax.random.fold_in(jax.random.fold_in(
+                jax.random.fold_in(base_key, 1), cfg.bagging_seed),
+                ref_it)
             draw = jax.random.uniform(kbag, (n,))
             if pos_neg and not is_rf:
                 # per-class rates (LightGBM pos/neg_bagging_fraction)
@@ -731,7 +804,9 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
             sample_mask = rv
         if cfg.feature_fraction < 1.0:
             keep = max(1, int(round(num_f * cfg.feature_fraction)))
-            kf = jax.random.fold_in(jax.random.fold_in(base_key, 2), it)
+            kf = jax.random.fold_in(jax.random.fold_in(
+                jax.random.fold_in(base_key, 2),
+                cfg.feature_fraction_seed), it)
             perm = jax.random.permutation(kf, num_f)
             feat_mask = jnp.zeros(num_f, jnp.float32).at[perm[:keep]].set(1.0)
         else:
@@ -765,9 +840,10 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
         for cls in range(k):
             gc = g if k == 1 else g[:, cls]
             hc = h if k == 1 else h[:, cls]
-            if cfg.extra_trees:
-                kt = jax.random.fold_in(
-                    jax.random.fold_in(base_key, 4 + cls), it)
+            if cfg.extra_trees or cfg.feature_fraction_by_node < 1.0:
+                kt = jax.random.fold_in(jax.random.fold_in(
+                    jax.random.fold_in(base_key, 4 + cls),
+                    cfg.extra_seed), it)
                 sf, tb, nv, cnt, dt, bgl = build_tree(
                     binned, gc.astype(jnp.float32), hc.astype(jnp.float32),
                     sample_mask.astype(jnp.float32), feat_mask,
@@ -899,6 +975,11 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
             if init_raw is None:
                 raise ValueError("warm start needs init_raw (the init "
                                  "model's raw scores on the training rows)")
+        elif init_raw is not None:
+            # standalone per-row init scores (LightGBM init_score):
+            # boost_from_average is auto-disabled and the offset is NOT
+            # recorded in the model (predict excludes it, as LightGBM)
+            base_score = 0.0
         else:
             base_score = (obj_mod.init_score(cfg.objective, labels, weights)
                           if cfg.boost_from_average and cfg.objective != "lambdarank"
@@ -973,9 +1054,10 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
 
     # raw scores, (N,) or (N,K)
     raw_shape = (n,) if k == 1 else (n, k)
-    if init_model is not None:
-        # warm start (modelString continuation, LightGBMBase.scala:48-51):
-        # init_raw already includes the old model's base score
+    if init_raw is not None:
+        # warm start (modelString continuation, LightGBMBase.scala:48-51,
+        # where init_raw includes the old model's base score) or
+        # standalone init scores (initScoreCol)
         raw = jnp.asarray(np.asarray(init_raw, dtype=np.float32).reshape(raw_shape))
     else:
         raw = jnp.full(raw_shape, base_score, dtype=jnp.float32)
@@ -984,7 +1066,7 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
     for vi, vset in enumerate(valid_sets or []):
         vb, vy, vw = vset[:3]
         vgroup = vset[3] if len(vset) > 3 else None
-        if init_model is not None and valid_init_raws is not None:
+        if valid_init_raws is not None:
             vraw = jnp.asarray(np.asarray(
                 valid_init_raws[vi], dtype=np.float32).reshape(
                     (vb.shape[0],) if k == 1 else (vb.shape[0], k)))
@@ -1085,7 +1167,13 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
         num_class=k,
         objective=cfg.objective,
         init_score=base_score,
-        decision_type=dt_all if cat_bitset is not None else None,
+        decision_type=(
+            dt_all if cat_bitset is not None
+            # numeric-only trees don't retain per-tree decision bits,
+            # but zero-as-missing scoring needs the zero-missing stamp
+            # (6 = default-left | missing_type zero) on internal nodes
+            else np.where(sf_all >= 0, 6, 0).astype(np.int8)
+            if cfg.zero_as_missing else None),
         cat_bitset=cat_bitset,
     )
     if init_model is not None:
@@ -1165,7 +1253,11 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
             j = es_fed
             es_fed += 1
             cur = float(met_host[j][vidx])
-            improved = cur > best_val if higher_better else cur < best_val
+            # TrainUtils.scala:143-169: improvement must clear the
+            # tolerance (higher-better), or stay within it (lower-better)
+            tol = cfg.improvement_tolerance
+            improved = (cur - best_val > tol if higher_better
+                        else cur - best_val < tol)
             if improved:
                 best_val, best_iter, rounds_no_improve = cur, j, 0
             else:
@@ -1277,12 +1369,24 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
     objective_fn = custom_objective or obj_mod.get_objective(cfg.objective)
     obj_kwargs = _objective_kwargs(cfg)
     if cfg.objective == "lambdarank":
-        obj_kwargs = {"group_ids": group_ids_dev, "sigmoid": cfg.sigmoid}
+        obj_kwargs = {
+            "group_ids": group_ids_dev, "sigmoid": cfg.sigmoid,
+            "truncation_level": cfg.lambdarank_truncation_level}
+        if cfg.label_gain:
+            obj_kwargs["label_gain"] = tuple(cfg.label_gain)
 
     # offset keys the host/device RNG streams so a resumed segment
     # continues rather than replays (exact on the fused path; the eager
     # loop's host RNG re-seeds per segment)
-    rng = np.random.default_rng(cfg.seed + iteration_offset)
+    bag_rng = np.random.default_rng(
+        cfg.seed * 1000003 + cfg.bagging_seed + iteration_offset)
+    ff_rng = np.random.default_rng(
+        cfg.seed * 1000003 + cfg.feature_fraction_seed + iteration_offset)
+    # DART drop decisions ride a dedicated stream (LightGBM drop_seed)
+    # so changing drop params never perturbs bagging/feature sampling
+    drop_rng = np.random.default_rng(
+        (cfg.seed + 4 if cfg.drop_seed is None else cfg.drop_seed)
+        + iteration_offset)
     trees_sf, trees_tb, trees_nv, trees_cnt = [], [], [], []
     trees_dt, trees_bgl = [], []
     tree_weights: List[float] = []
@@ -1308,23 +1412,34 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
                 thr_vec = np.where(labels_host > 0,
                                    cfg.pos_bagging_fraction,
                                    cfg.neg_bagging_fraction)
-                bag_mask = (rng.random(n) < thr_vec).astype(np.float32) * rv_host
+                bag_mask = (bag_rng.random(n) < thr_vec).astype(np.float32) * rv_host
             else:
                 frac = cfg.bagging_fraction if cfg.bagging_fraction < 1.0 else 0.632
-                bag_mask = (rng.random(n) < frac).astype(np.float32) * rv_host
+                bag_mask = (bag_rng.random(n) < frac).astype(np.float32) * rv_host
         feat_mask = np.ones(num_f, dtype=np.float32)
         if cfg.feature_fraction < 1.0:
             keep = max(1, int(round(num_f * cfg.feature_fraction)))
-            chosen = rng.choice(num_f, size=keep, replace=False)
+            chosen = ff_rng.choice(num_f, size=keep, replace=False)
             feat_mask = np.zeros(num_f, dtype=np.float32)
             feat_mask[chosen] = 1.0
 
         # ----- dart: drop trees for this iteration's gradients -----------
         raw_for_grad = raw
         dropped: List[int] = []
-        if is_dart and trees_sf and rng.random() >= cfg.skip_drop:
-            drops = rng.random(len(trees_sf)) < cfg.drop_rate
+        if is_dart and trees_sf and drop_rng.random() >= cfg.skip_drop:
+            if cfg.uniform_drop:
+                probs = np.full(len(trees_sf), cfg.drop_rate)
+            else:
+                # LightGBM dart.hpp: drop probability proportional to
+                # tree weight, normalized to mean drop_rate
+                wts = np.asarray(tree_weights, dtype=np.float64)
+                mean_w = max(float(wts.mean()), 1e-12)
+                probs = np.clip(cfg.drop_rate * wts / mean_w, 0.0, 1.0)
+            drops = drop_rng.random(len(trees_sf)) < probs
             dropped = list(np.nonzero(drops)[0])
+            if cfg.max_drop > 0 and len(dropped) > cfg.max_drop:
+                dropped = sorted(drop_rng.choice(
+                    dropped, size=cfg.max_drop, replace=False))
             for i in dropped:  # tree i belongs to class i % k
                 contrib = dart_tree_preds[i] * tree_weights[i]
                 if k == 1:
@@ -1366,9 +1481,10 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
             hc = h if k == 1 else h[:, cls]
             with measures.phase("training"):
                 kw = {}
-                if cfg.extra_trees:
+                if cfg.extra_trees or cfg.feature_fraction_by_node < 1.0:
                     kw["key"] = jax.random.fold_in(jax.random.fold_in(
-                        jax.random.key(cfg.seed), 4 + cls),
+                        jax.random.fold_in(jax.random.key(cfg.seed),
+                                           4 + cls), cfg.extra_seed),
                         it + iteration_offset)
                 sf, tb, nv, cnt, dt, bgl = build_tree(
                     binned_d, jnp.asarray(gc, jnp.float32),
@@ -1442,7 +1558,11 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
 
         if cfg.early_stopping_round > 0 and valid_states:
             cur = record[f"valid0_{metric_list[0][0]}"]
-            improved = cur > best_val if higher_better else cur < best_val
+            # TrainUtils.scala:143-169: improvement must clear the
+            # tolerance (higher-better), or stay within it (lower-better)
+            tol = cfg.improvement_tolerance
+            improved = (cur - best_val > tol if higher_better
+                        else cur - best_val < tol)
             if improved:
                 best_val, best_iter, rounds_no_improve = cur, it, 0
             else:

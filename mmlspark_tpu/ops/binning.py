@@ -53,12 +53,16 @@ class BinMapper:
     @staticmethod
     def fit(sample: np.ndarray, max_bin: int = 255,
             categorical_features: Sequence[int] = (),
-            min_data_in_bin: int = 3) -> "BinMapper":
+            min_data_in_bin: int = 3,
+            max_bin_by_feature: Optional[Sequence[int]] = None
+            ) -> "BinMapper":
         """Compute bin boundaries from a host-side row sample.
 
         Quantile binning over distinct values, merging bins that would
         hold fewer than ``min_data_in_bin`` sampled rows (LightGBM's
-        ``min_data_in_bin`` semantics).
+        ``min_data_in_bin`` semantics). ``max_bin_by_feature`` caps
+        individual features below ``max_bin`` (LightGBM
+        max_bin_by_feature; entries <= 0 mean no override).
         """
         sample = np.asarray(sample, dtype=np.float64)
         n, num_f = sample.shape
@@ -66,13 +70,22 @@ class BinMapper:
         cat[list(categorical_features)] = True
         edges: List[np.ndarray] = []
         cats: List[Optional[np.ndarray]] = []
+
+        def feat_max_bin(fi):
+            if max_bin_by_feature is None or fi >= len(max_bin_by_feature):
+                return max_bin
+            o = int(max_bin_by_feature[fi])
+            # floor of 4 mirrors the maxBin validator: below that the
+            # missing + catch-all reservation leaves no usable bins
+            return min(max_bin, max(o, 4)) if o > 0 else max_bin
+
         for f in range(num_f):
             col = sample[:, f]
             col = col[~np.isnan(col)]
             if cat[f]:
                 edges.append(np.empty(0))
                 vals, counts = np.unique(col.astype(np.int64), return_counts=True)
-                cap = max_bin - 2  # rare categories overflow to the
+                cap = feat_max_bin(f) - 2  # rare categories overflow to the
                 if len(vals) > cap:  # missing/other bin (LightGBM-style cap)
                     keep = np.sort(vals[np.argsort(-counts)[:cap]])
                     vals = keep
@@ -83,7 +96,7 @@ class BinMapper:
                 edges.append(np.empty(0))
                 continue
             uniq, counts = np.unique(col, return_counts=True)
-            usable_bins = max_bin - 2  # reserve missing bin + catch-all
+            usable_bins = feat_max_bin(f) - 2  # reserve missing + catch-all
             if len(uniq) <= usable_bins:
                 # boundary = midpoint between adjacent distinct values
                 e = (uniq[:-1] + uniq[1:]) / 2.0

@@ -31,9 +31,13 @@ def _fit(x, y, categorical, num_iterations=20, **kw):
     cat_idx = [0] if categorical else []
     mapper = BinMapper.fit(x, max_bin=64, categorical_features=cat_idx)
     binned = mapper.transform(x)
+    # small fixtures have < 100 rows per category, so the LightGBM
+    # default min_data_per_group would filter every sorted-scan
+    # candidate (test_min_data_per_group pins that behavior)
     cfg = TrainConfig(objective="binary", num_iterations=num_iterations,
                       num_leaves=8, max_depth=3, min_data_in_leaf=5,
-                      max_bin=64, categorical_features=tuple(cat_idx), **kw)
+                      max_bin=64, categorical_features=tuple(cat_idx),
+                      **{"min_data_per_group": 10, **kw})
     result = train(binned, y, cfg, bin_upper=mapper.bin_upper_values(64))
     return result, mapper
 
@@ -166,7 +170,7 @@ class TestCategoricalSplits:
         df = DataFrame({"features": x, "label": y})
         model = LightGBMClassifier(
             numIterations=8, numLeaves=8, maxDepth=3, maxBin=64,
-            categoricalSlotIndexes=[0]).fit(df)
+            categoricalSlotIndexes=[0], minDataPerGroup=10).fit(df)
         out = model.transform(df)
         acc = float((out["prediction"] == y).mean())
         assert acc > 0.85

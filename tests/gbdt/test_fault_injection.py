@@ -1,0 +1,107 @@
+"""Fault injection on the training path (SURVEY.md §5 failure handling).
+
+The reference's fault story is Spark task retry + barrier mode; the
+analog here is elastic checkpoint/resume: a fit killed WITHOUT warning
+(SIGKILL, no atexit, no finally) must resume from its last atomic
+checkpoint and reproduce the uninterrupted run bit-for-bit.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+
+_FIT_SCRIPT = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+
+rng = np.random.default_rng(7)
+x = rng.normal(size=(2000, 4))
+y = 2.0 * x[:, 0] - x[:, 1] + rng.normal(size=2000) * 0.1
+df = DataFrame({{"features": x, "label": y}})
+print("FITTING", flush=True)
+LightGBMRegressor(numIterations=40, numLeaves=8, maxBin=32,
+                  checkpointDir={ckdir!r}, checkpointInterval=4).fit(df)
+print("DONE", flush=True)
+"""
+
+
+def _data():
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(2000, 4))
+    y = 2.0 * x[:, 0] - x[:, 1] + rng.normal(size=2000) * 0.1
+    return DataFrame({"features": x, "label": y}), x, y
+
+
+def test_sigkill_mid_fit_resumes_bit_exact(tmp_path):
+    ckdir = str(tmp_path / "ck")
+    env = dict(os.environ,
+               PYTHONPATH=os.getcwd() + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _FIT_SCRIPT.format(ckdir=ckdir)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
+    try:
+        # hard-kill the trainer as soon as a mid-training checkpoint
+        # lands (no cleanup handlers get to run)
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            done = [n for n in os.listdir(ckdir)] if os.path.isdir(ckdir) \
+                else []
+            if any(n.startswith("checkpoint_") and n.endswith(".txt")
+                   for n in done):
+                break
+            if proc.poll() is not None:
+                out, err = proc.communicate()
+                pytest.fail(f"fit finished before kill: {err[-500:]}")
+            time.sleep(0.05)
+        else:
+            proc.kill()
+            pytest.skip("no checkpoint appeared within timeout")
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == -signal.SIGKILL
+
+    # partial state only: some checkpoints, no finished model
+    names = sorted(n for n in os.listdir(ckdir) if n.endswith(".txt"))
+    assert names, "kill happened after a checkpoint landed"
+    assert f"checkpoint_40.txt" not in names
+
+    df, x, y = _data()
+    kw = dict(numIterations=40, numLeaves=8, maxBin=32)
+    resumed = LightGBMRegressor(checkpointDir=ckdir, checkpointInterval=4,
+                                **kw).fit(df)
+    fresh = LightGBMRegressor(**kw).fit(df)
+    assert resumed.booster.num_trees == 40
+    np.testing.assert_allclose(
+        np.asarray(resumed.transform(df)["prediction"]),
+        np.asarray(fresh.transform(df)["prediction"]), atol=1e-5)
+
+
+def test_corrupt_partial_checkpoint_is_invisible(tmp_path):
+    """The atomic rename protocol: a torn half-written .tmp file from a
+    crashed writer must never be picked up on resume."""
+    df, x, y = _data()
+    ckdir = str(tmp_path / "ck")
+    kw = dict(numIterations=8, numLeaves=8, maxBin=32,
+              checkpointDir=ckdir, checkpointInterval=4)
+    LightGBMRegressor(**kw).fit(df)
+    os.remove(os.path.join(ckdir, "checkpoint_8.txt"))
+    # a torn write that never reached os.replace
+    with open(os.path.join(ckdir, ".checkpoint_8.tmp"), "w") as fh:
+        fh.write("tree\nversion=v4\ngarbage")
+    resumed = LightGBMRegressor(**{**kw, "numIterations": 12}).fit(df)
+    assert resumed.booster.num_trees == 12
