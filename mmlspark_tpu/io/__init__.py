@@ -39,6 +39,16 @@ from mmlspark_tpu.io.cognitive_services import (
     PIIRecognizer,
     TextSentiment,
     Translate,
+    AnalyzeText,
+    AddDocuments,
+    AzureSearchWriter,
+    SpeechToText,
+    SpeechToTextSDK,
+    TextToSpeech,
+    BingImageSearch,
+    AddressGeocoder,
+    ReverseAddressGeocoder,
+    CheckPointInPolygon,
 )
 from mmlspark_tpu.io.binary import (
     PowerBIWriter,
@@ -56,5 +66,9 @@ __all__ = ["HTTPTransformer", "SimpleHTTPTransformer", "HTTPResponseData",
            "EntityRecognizer", "PIIRecognizer", "Translate",
            "DetectLastAnomaly", "DetectAnomalies", "AnalyzeImage",
            "DescribeImage", "OCR", "DetectFace",
+           "AnalyzeText", "AddDocuments", "AzureSearchWriter",
+           "SpeechToText", "SpeechToTextSDK", "TextToSpeech",
+           "BingImageSearch", "AddressGeocoder",
+           "ReverseAddressGeocoder", "CheckPointInPolygon",
            "PowerBIWriter", "read_binary_files", "read_image_files",
            "write_to_power_bi"]

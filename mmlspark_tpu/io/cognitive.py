@@ -49,6 +49,61 @@ class CognitiveServiceTransformer(Transformer, HasOutputCol):
             h["Authorization"] = f"Bearer {self.get('aadToken')}"
         return h
 
+    def _open_retrying(self, req):
+        """urlopen with the family's transient-error policy: retry
+        429/5xx and connection blips with backoff (Retry-After
+        honored), like the sync transformers' HTTP layer (io/http.py)."""
+        import time as _time
+        import urllib.error
+        import urllib.request
+
+        delays = (0.0, 0.2, 1.0)
+        last = None
+        for delay in delays:
+            if delay:
+                _time.sleep(delay)
+            try:
+                return urllib.request.urlopen(req,
+                                              timeout=self.get("timeout"))
+            except urllib.error.HTTPError as e:
+                last = e
+                if e.code != 429 and e.code < 500:
+                    raise
+                retry_after = e.headers.get("Retry-After")
+                if retry_after:
+                    _time.sleep(min(float(retry_after), 5.0))
+            except OSError as e:  # URLError/timeouts/conn resets
+                last = e
+        raise last
+
+    def _row_parallel(self, dataset, run_one):
+        """Run ``run_one(row) -> value`` over all rows with up to
+        ``concurrency`` requests in flight; returns the transformed
+        frame with output + error columns. Shared by the families whose
+        requests aren't simple JSON POSTs (speech, bing, async)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        import numpy as np
+
+        outputs = np.empty(dataset.num_rows, dtype=object)
+        errors = np.empty(dataset.num_rows, dtype=object)
+
+        def work(i_row):
+            i, row = i_row
+            try:
+                return i, run_one(row), None
+            except Exception as e:
+                return i, None, str(e)
+
+        rows = list(enumerate(dataset.iter_rows()))
+        with ThreadPoolExecutor(max_workers=max(
+                self.get("concurrency"), 1)) as ex:
+            for i, out, err in ex.map(work, rows):
+                outputs[i] = out
+                errors[i] = err
+        return (dataset.with_column(self.get("outputCol"), outputs)
+                .with_column(self.get("errorCol"), errors))
+
     def _build_body(self, row: Dict[str, Any]) -> Any:
         raise NotImplementedError
 

@@ -10,8 +10,10 @@ Request/response wire formats match the public Azure APIs, so the same
 transformers work against real services when egress exists; tests run
 them against canned local servers.
 
-Speech (binary audio streaming) and the async form-recognizer protocol
-are intentionally out of scope for this layer.
+The async form-recognizer protocol lives in _AsyncCognitiveBase; the
+speech family streams audio as chunked REST uploads (the SDK's
+websocket stream has no zero-dependency analog, so SpeechToTextSDK
+replays its continuous-recognition semantics over chunk POSTs).
 """
 
 from __future__ import annotations
@@ -20,7 +22,8 @@ from typing import Any, Dict, List
 
 import numpy as np
 
-from mmlspark_tpu.core.param import Param, to_bool, to_float, to_int, to_str
+from mmlspark_tpu.core.param import (Param, one_of, to_bool, to_float,
+                                     to_int, to_str)
 from mmlspark_tpu.io.cognitive import CognitiveServiceTransformer
 
 
@@ -169,35 +172,6 @@ class _AsyncCognitiveBase(CognitiveServiceTransformer):
     maxPollRetries = Param("maxPollRetries", "max status polls before "
                            "giving up", to_int, default=40)
 
-    def _open_retrying(self, req):
-        """urlopen with the family's transient-error policy: retry
-        429/5xx with backoff (Retry-After honored), like the sync
-        transformers' HTTP layer (io/http.py)."""
-        import time as _time
-        import urllib.error
-        import urllib.request
-
-        delays = (0.0, 0.2, 1.0)
-        last = None
-        for delay in delays:
-            if delay:
-                _time.sleep(delay)
-            try:
-                return urllib.request.urlopen(req,
-                                              timeout=self.get("timeout"))
-            except urllib.error.HTTPError as e:
-                last = e
-                if e.code != 429 and e.code < 500:
-                    raise
-                retry_after = e.headers.get("Retry-After")
-                if retry_after:
-                    _time.sleep(min(float(retry_after), 5.0))
-            except OSError as e:  # URLError/timeouts/conn resets
-                # connection resets / momentary network blips are as
-                # transient as a 503 — same policy as the sync layer
-                last = e
-        raise last
-
     def _run_one(self, row):
         import json as _json
         import time as _time
@@ -227,28 +201,8 @@ class _AsyncCognitiveBase(CognitiveServiceTransformer):
                            f"{self.get('maxPollRetries')} polls")
 
     def _transform(self, dataset):
-        from concurrent.futures import ThreadPoolExecutor
-
-        outputs = np.empty(dataset.num_rows, dtype=object)
-        errors = np.empty(dataset.num_rows, dtype=object)
-
-        def work(i_row):
-            i, row = i_row
-            try:
-                return i, self._run_one(row), None
-            except Exception as e:
-                return i, None, str(e)
-
-        rows = list(enumerate(dataset.iter_rows()))
         # polls dominate wall-clock: overlap rows up to `concurrency`
-        # like the sync family's async HTTP layer
-        with ThreadPoolExecutor(max_workers=max(
-                self.get("concurrency"), 1)) as ex:
-            for i, out, err in ex.map(work, rows):
-                outputs[i] = out
-                errors[i] = err
-        return (dataset.with_column(self.get("outputCol"), outputs)
-                .with_column(self.get("errorCol"), errors))
+        return self._row_parallel(dataset, self._run_one)
 
 
 class AnalyzeDocument(_AsyncCognitiveBase):
@@ -350,3 +304,373 @@ class DetectFace(_ImageUrlBase):
                  **({"faceAttributes": f.get("faceAttributes")}
                     if self.get("returnFaceAttributes") else {})}
                 for f in response]
+
+
+# ---------------------------------------------------------------------------
+# AnalyzeText family (language/AnalyzeText.scala:126 — the unified
+# Language API: one transformer, task selected by ``kind``)
+# ---------------------------------------------------------------------------
+
+class AnalyzeText(CognitiveServiceTransformer):
+    """POSTs ``{"kind", "analysisInput": {"documents": [...]},
+    "parameters": {...}}`` and returns the per-document result. Kinds
+    mirror AnalyzeText.scala:152 (kindCol is unsupported there for the
+    same reason as here: each kind has a different output schema)."""
+
+    KINDS = ("EntityLinking", "EntityRecognition", "KeyPhraseExtraction",
+             "LanguageDetection", "PiiEntityRecognition",
+             "SentimentAnalysis")
+
+    textCol = Param("textCol", "text column", to_str, default="text")
+    kind = Param("kind", "analysis task", to_str,
+                 one_of(*KINDS), default="SentimentAnalysis")
+    language = Param("language", "document language hint", to_str,
+                     default="en")
+    modelVersion = Param("modelVersion", "service model version", to_str,
+                         default="latest")
+    showStats = Param("showStats", "request corpus statistics", to_bool,
+                      default=False)
+
+    def _build_body(self, row):
+        doc = {"id": "0", "text": str(row[self.get("textCol")])}
+        if self.get("kind") != "LanguageDetection":
+            doc["language"] = self.get("language")
+        return {"kind": self.get("kind"),
+                "analysisInput": {"documents": [doc]},
+                "parameters": {"modelVersion": self.get("modelVersion"),
+                               "loggingOptOut": False,
+                               **({"showStats": True}
+                                  if self.get("showStats") else {})}}
+
+    def _parse(self, response):
+        try:
+            return response["results"]["documents"][0]
+        except (KeyError, IndexError, TypeError):
+            return response
+
+
+# ---------------------------------------------------------------------------
+# Azure Search sink (search/AzureSearch.scala:89 AddDocuments + the
+# writer with index creation, :210 writeToAzureSearch)
+# ---------------------------------------------------------------------------
+
+class AddDocuments(CognitiveServiceTransformer):
+    """Micro-batched index upload: rows become documents with an
+    ``@search.action`` verb, POSTed ``batchSize`` at a time; the output
+    column carries the service's per-document status."""
+
+    actionCol = Param("actionCol", "column with the per-row index "
+                      "action verb", to_str, default="@search.action")
+    batchSize = Param("batchSize", "documents per request", to_int,
+                      default=100)
+    fatalErrors = Param("fatalErrors", "raise on any failed document "
+                        "instead of recording it", to_bool, default=True)
+    filterNulls = Param("filterNulls", "drop null-valued fields from "
+                        "documents", to_bool, default=False)
+
+    def _transform(self, dataset):
+        import json as _json
+        import urllib.request
+
+        action_col = self.get("actionCol")
+        rows = list(dataset.iter_rows())
+        docs = []
+        for row in rows:
+            doc = {k: v for k, v in row.items()}
+            for k, v in list(doc.items()):
+                if isinstance(v, np.generic):
+                    doc[k] = v.item()
+                elif isinstance(v, np.ndarray):
+                    doc[k] = v.tolist()
+            if action_col not in doc:
+                doc[action_col] = "upload"
+            if self.get("filterNulls"):
+                doc = {k: v for k, v in doc.items() if v is not None}
+            docs.append(doc)
+        statuses = np.empty(len(docs), dtype=object)
+        headers = {"Content-Type": "application/json", **self._headers()}
+        bs = self.get("batchSize")
+        for start in range(0, len(docs), bs):
+            batch = docs[start:start + bs]
+            req = urllib.request.Request(
+                self.get("url"), data=_json.dumps({"value": batch}).encode(),
+                headers=headers)
+            with self._open_retrying(req) as r:
+                reply = _json.loads(r.read())
+            for j, st in enumerate(reply.get("value", [])):
+                if start + j < len(statuses):
+                    statuses[start + j] = st
+                if self.get("fatalErrors") and not st.get("status", True):
+                    raise RuntimeError(
+                        f"index upload failed for key "
+                        f"{st.get('key')!r}: {st.get('errorMessage')}")
+        return dataset.with_column(self.get("outputCol"), statuses)
+
+
+class AzureSearchWriter:
+    """``write(df, options)`` analog of AzureSearchWriter.scala:229:
+    creates the index from ``indexJson`` when absent (PUT
+    /indexes/<name>), then streams the frame through
+    :class:`AddDocuments`."""
+
+    @staticmethod
+    def write(df, url: str, index_json: str = None, key: str = "",
+              batch_size: int = 100, action_col: str = "@search.action",
+              fatal_errors: bool = True, timeout: float = 60.0):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        if index_json:
+            spec = _json.loads(index_json)
+            name = spec["name"]
+            req = urllib.request.Request(
+                f"{url.rstrip('/')}/indexes/{name}",
+                data=_json.dumps(spec).encode(), method="PUT",
+                headers={"Content-Type": "application/json",
+                         "api-key": key})
+            try:
+                urllib.request.urlopen(req, timeout=timeout).close()
+            except urllib.error.HTTPError as e:
+                if e.code != 409:  # already exists
+                    raise
+            docs_url = f"{url.rstrip('/')}/indexes/{name}/docs/index"
+        else:
+            docs_url = url
+        stage = AddDocuments(url=docs_url, subscriptionKey=key,
+                             batchSize=batch_size, actionCol=action_col,
+                             fatalErrors=fatal_errors, timeout=timeout,
+                             outputCol="indexStatus")
+        return stage.transform(df)
+
+
+# ---------------------------------------------------------------------------
+# Speech family (speech/SpeechToText.scala:23 REST one-shot;
+# speech/SpeechToTextSDK.scala:79 continuous recognition — the SDK's
+# websocket stream is replaced by chunked REST segment upload, the
+# zero-dependency analog; speech/TextToSpeech.scala)
+# ---------------------------------------------------------------------------
+
+class SpeechToText(CognitiveServiceTransformer):
+    """One-shot recognition: POST audio bytes, parse DisplayText."""
+
+    audioDataCol = Param("audioDataCol", "audio bytes column", to_str,
+                         default="audio")
+    language = Param("language", "recognition language", to_str,
+                     default="en-US")
+    format = Param("format", "simple | detailed", to_str, default="simple")
+
+    def _audio_bytes(self, row):
+        v = row[self.get("audioDataCol")]
+        if isinstance(v, np.ndarray):
+            v = v.astype(np.float32).tobytes()
+        elif isinstance(v, str):
+            v = v.encode()
+        return v
+
+    def _transform(self, dataset):
+        import json as _json
+        import urllib.request
+
+        url = (f"{self.get('url')}?language={self.get('language')}"
+               f"&format={self.get('format')}")
+        headers = {"Content-Type": "audio/wav", **self._headers()}
+
+        def run_one(row):
+            req = urllib.request.Request(
+                url, data=self._audio_bytes(row), headers=headers)
+            with self._open_retrying(req) as r:
+                return self._parse(_json.loads(r.read()))
+
+        return self._row_parallel(dataset, run_one)
+
+    def _parse(self, response):
+        if isinstance(response, dict) and "DisplayText" in response:
+            return response["DisplayText"]
+        return response
+
+
+class SpeechToTextSDK(SpeechToText):
+    """Continuous recognition: audio is cut into ``chunkMs`` frames and
+    streamed chunk-by-chunk; every response segment is collected, so
+    the output column holds the ordered transcript segments (the
+    BlockingQueueIterator stream of SpeechToTextSDK.scala:44, minus the
+    websocket). ``streamIntermediateResults`` keeps per-chunk partials;
+    off, segments are joined to one transcript string."""
+
+    chunkMs = Param("chunkMs", "audio milliseconds per streamed chunk",
+                    to_int, default=1000)
+    sampleRate = Param("sampleRate", "PCM sample rate (Hz)", to_int,
+                       default=16000)
+    bytesPerSample = Param("bytesPerSample", "PCM bytes per sample",
+                           to_int, default=2)
+    streamIntermediateResults = Param(
+        "streamIntermediateResults", "emit one row element per segment "
+        "instead of the joined transcript", to_bool, default=True)
+
+    def _transform(self, dataset):
+        import json as _json
+        import urllib.request
+
+        url = (f"{self.get('url')}?language={self.get('language')}"
+               f"&format={self.get('format')}")
+        headers = {"Content-Type": "audio/wav", **self._headers()}
+
+        def run_one(row):
+            v = row[self.get("audioDataCol")]
+            # ndarray audio serializes as float32 (4 bytes/sample)
+            # regardless of the PCM param, which describes raw bytes
+            bps = 4 if isinstance(v, np.ndarray) \
+                else self.get("bytesPerSample")
+            audio = self._audio_bytes(row)
+            chunk_bytes = max(1, (self.get("sampleRate") * bps
+                                  * self.get("chunkMs")) // 1000)
+            # never tear a sample across chunks
+            chunk_bytes = max(bps, (chunk_bytes // bps) * bps)
+            segments = []
+            for off in range(0, len(audio), chunk_bytes):
+                req = urllib.request.Request(
+                    url, data=audio[off:off + chunk_bytes],
+                    headers=headers)
+                with self._open_retrying(req) as r:
+                    seg = self._parse(_json.loads(r.read()))
+                if seg:
+                    segments.append(seg)
+            return (segments if self.get("streamIntermediateResults")
+                    else " ".join(str(s) for s in segments))
+
+        return self._row_parallel(dataset, run_one)
+
+
+class TextToSpeech(CognitiveServiceTransformer):
+    """SSML synthesis: POST the text, the output column carries the
+    returned audio bytes (speech/TextToSpeech.scala)."""
+
+    textCol = Param("textCol", "text column", to_str, default="text")
+    voiceName = Param("voiceName", "synthesis voice", to_str,
+                      default="en-US-JennyNeural")
+    outputFormat = Param("outputFormat", "audio container/codec", to_str,
+                         default="riff-16khz-16bit-mono-pcm")
+
+    def _transform(self, dataset):
+        import urllib.request
+        from xml.sax.saxutils import escape, quoteattr
+
+        headers = {"Content-Type": "application/ssml+xml",
+                   "X-Microsoft-OutputFormat": self.get("outputFormat"),
+                   **self._headers()}
+        voice = quoteattr(self.get("voiceName"))
+
+        def run_one(row):
+            text = escape(str(row[self.get("textCol")]))
+            ssml = (f"<speak version='1.0' xml:lang='en-US'>"
+                    f"<voice name={voice}>{text}</voice></speak>")
+            req = urllib.request.Request(self.get("url"),
+                                         data=ssml.encode(),
+                                         headers=headers)
+            with self._open_retrying(req) as r:
+                return r.read()
+
+        return self._row_parallel(dataset, run_one)
+
+
+# ---------------------------------------------------------------------------
+# Bing image search (bing/BingImageSearch.scala:67 — GET with query)
+# ---------------------------------------------------------------------------
+
+class BingImageSearch(CognitiveServiceTransformer):
+    queryCol = Param("queryCol", "search query column", to_str,
+                     default="q")
+    count = Param("count", "results per query", to_int, default=10)
+    offset = Param("offset", "result offset", to_int, default=0)
+
+    def _transform(self, dataset):
+        import json as _json
+        import urllib.parse
+        import urllib.request
+
+        def run_one(row):
+            q = urllib.parse.quote(str(row[self.get("queryCol")]))
+            url = (f"{self.get('url')}?q={q}&count={self.get('count')}"
+                   f"&offset={self.get('offset')}")
+            req = urllib.request.Request(url, headers=self._headers())
+            with self._open_retrying(req) as r:
+                return self._parse(_json.loads(r.read()))
+
+        return self._row_parallel(dataset, run_one)
+
+    def _parse(self, response):
+        if isinstance(response, dict) and "value" in response:
+            return [{"contentUrl": v.get("contentUrl"),
+                     "name": v.get("name")} for v in response["value"]]
+        return response
+
+    @staticmethod
+    def downloads_from_results(results) -> List[str]:
+        """Flatten contentUrls from scored rows
+        (BingImageSearch.downloadFromUrls helper analog)."""
+        urls: List[str] = []
+        for r in results:
+            if isinstance(r, list):
+                urls.extend(v.get("contentUrl") for v in r
+                            if isinstance(v, dict))
+        return [u for u in urls if u]
+
+
+# ---------------------------------------------------------------------------
+# Azure Maps geospatial (geospatial/Geocoders.scala,
+# CheckPointInPolygon.scala)
+# ---------------------------------------------------------------------------
+
+class AddressGeocoder(CognitiveServiceTransformer):
+    """Address -> lat/lon via the Maps search API."""
+
+    addressCol = Param("addressCol", "address column", to_str,
+                       default="address")
+
+    def _build_body(self, row):
+        return {"query": str(row[self.get("addressCol")])}
+
+    def _parse(self, response):
+        try:
+            pos = response["results"][0]["position"]
+            return {"lat": pos["lat"], "lon": pos["lon"]}
+        except (KeyError, IndexError, TypeError):
+            return response
+
+
+class ReverseAddressGeocoder(CognitiveServiceTransformer):
+    """lat/lon -> address via the Maps reverse-search API."""
+
+    latCol = Param("latCol", "latitude column", to_str, default="lat")
+    lonCol = Param("lonCol", "longitude column", to_str, default="lon")
+
+    def _build_body(self, row):
+        return {"query": f"{row[self.get('latCol')]},"
+                         f"{row[self.get('lonCol')]}"}
+
+    def _parse(self, response):
+        try:
+            return response["addresses"][0]["address"]
+        except (KeyError, IndexError, TypeError):
+            return response
+
+
+class CheckPointInPolygon(CognitiveServiceTransformer):
+    """Point-in-geofence query (CheckPointInPolygon.scala)."""
+
+    latCol = Param("latCol", "latitude column", to_str, default="lat")
+    lonCol = Param("lonCol", "longitude column", to_str, default="lon")
+    userDataIdentifier = Param("userDataIdentifier", "uploaded geofence "
+                               "udid", to_str)
+
+    def _build_body(self, row):
+        return {"lat": float(row[self.get("latCol")]),
+                "lon": float(row[self.get("lonCol")]),
+                "udid": self.get("userDataIdentifier")}
+
+    def _parse(self, response):
+        try:
+            return bool(response["result"]["pointInPolygons"])
+        except (KeyError, TypeError):
+            return response

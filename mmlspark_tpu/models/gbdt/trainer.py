@@ -1374,6 +1374,11 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
             "truncation_level": cfg.lambdarank_truncation_level}
         if cfg.label_gain:
             obj_kwargs["label_gain"] = tuple(cfg.label_gain)
+    if custom_objective is not None:
+        # the documented fobj contract is (preds, labels, weights) ->
+        # (grad, hess): the named objective's kwargs must not leak in
+        # (group-aware custom objectives close over their group ids)
+        obj_kwargs = {}
 
     # offset keys the host/device RNG streams so a resumed segment
     # continues rather than replays (exact on the fused path; the eager

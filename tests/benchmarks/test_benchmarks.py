@@ -60,6 +60,12 @@ def test_lightgbm_classifier_benchmarks():
     bench.verify()
 
 
+# Round-4 note: the regressor CSV was re-pinned after the seed-family
+# rework (dedicated bagging/feature-fraction/drop RNG streams) and the
+# LightGBM-default weighted DART drop. The l2_dart move (1.03 -> 1.40)
+# was verified to be pure RNG-stream reshuffle on this 10-tree/400-row
+# fixture: uniform vs weighted drop produce identical values here, and
+# changing dropSeed alone swings l2 between 1.05 and 1.40.
 def test_lightgbm_regressor_benchmarks():
     df = _reg_data()
     bench = Benchmarks("VerifyLightGBMRegressor")

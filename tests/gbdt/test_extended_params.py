@@ -225,6 +225,26 @@ def test_max_bin_by_feature(reg_df):
     assert 0 < len(f0_thr) <= 6
 
 
+def test_custom_objective_fobj_multiclass(rng):
+    """fobj must be called with the documented (preds, labels, weights)
+    signature even when the resolved objective has extra kwargs
+    (r4 review: multiclass leaked num_class into the call)."""
+    import jax.numpy as jnp
+    x = rng.normal(size=(600, 4))
+    y = (x[:, 0] > 0).astype(np.float64) + (x[:, 1] > 0)
+
+    def soft_obj(preds, labels, weights=None):
+        import jax
+        p = jax.nn.softmax(preds, axis=-1)
+        yh = jax.nn.one_hot(labels.astype(jnp.int32), preds.shape[-1])
+        return p - yh, 2.0 * p * (1.0 - p)
+
+    df = DataFrame({"features": x, "label": y})
+    m = LightGBMClassifier(fobj=soft_obj, numIterations=4, numLeaves=8,
+                           maxBin=32).fit(df)
+    assert (np.asarray(m.transform(df)["prediction"]) == y).mean() > 0.8
+
+
 def test_custom_objective_fobj(reg_df):
     df, x, y = reg_df
 

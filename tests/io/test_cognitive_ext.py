@@ -1,0 +1,227 @@
+"""Round-4 cognitive families against live local mock servers:
+AnalyzeText (language/AnalyzeText.scala), the AzureSearch sink
+(search/AzureSearch.scala), the speech family (speech/*.scala), bing
+image search, and Azure Maps geospatial."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.io import (
+    AddDocuments,
+    AddressGeocoder,
+    AnalyzeText,
+    AzureSearchWriter,
+    BingImageSearch,
+    CheckPointInPolygon,
+    SpeechToText,
+    SpeechToTextSDK,
+    TextToSpeech,
+)
+
+
+@pytest.fixture()
+def server():
+    """Mock handling JSON POST, raw-body POST, GET and PUT, recording
+    everything; per-path canned replies."""
+    state = {"replies": {}, "requests": []}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def _reply(self):
+            path = self.path.split("?")[0]
+            n = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(n)
+            try:
+                body = json.loads(raw)
+            except (ValueError, UnicodeDecodeError):
+                body = raw
+            state["requests"].append(
+                {"method": self.command, "path": self.path, "body": body,
+                 "headers": dict(self.headers)})
+            reply = state["replies"].get(path, {})
+            if callable(reply):
+                reply = reply(body)
+            if isinstance(reply, bytes):
+                out = reply
+                ctype = "application/octet-stream"
+            else:
+                out = json.dumps(reply).encode()
+                ctype = "application/json"
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        do_POST = do_GET = do_PUT = _reply
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", state
+    httpd.shutdown()
+    httpd.server_close()
+
+
+class TestAnalyzeText:
+    def test_kinds_and_body_shape(self, server):
+        url, state = server
+        state["replies"]["/language"] = {
+            "kind": "SentimentAnalysisResults",
+            "results": {"documents": [
+                {"id": "0", "sentiment": "positive"}]}}
+        df = DataFrame({"text": np.array(["great stuff"], dtype=object)})
+        out = AnalyzeText(url=url + "/language", subscriptionKey="k",
+                          kind="SentimentAnalysis",
+                          outputCol="res").transform(df)
+        assert out["res"][0]["sentiment"] == "positive"
+        sent = state["requests"][-1]["body"]
+        assert sent["kind"] == "SentimentAnalysis"
+        assert sent["analysisInput"]["documents"][0]["text"] == "great stuff"
+        assert sent["parameters"]["modelVersion"] == "latest"
+        # language detection omits the language hint (service infers it)
+        state["replies"]["/language"] = {
+            "results": {"documents": [
+                {"id": "0", "detectedLanguage": {"name": "French"}}]}}
+        out = AnalyzeText(url=url + "/language", kind="LanguageDetection",
+                          outputCol="res").transform(df)
+        assert out["res"][0]["detectedLanguage"]["name"] == "French"
+        assert "language" not in state["requests"][-1]["body"][
+            "analysisInput"]["documents"][0]
+
+    def test_invalid_kind_rejected(self):
+        with pytest.raises(ValueError, match="kind"):
+            AnalyzeText(kind="Nonsense")
+
+
+class TestAzureSearch:
+    def test_add_documents_batches(self, server):
+        url, state = server
+        state["replies"]["/docs"] = lambda body: {
+            "value": [{"key": d.get("id"), "status": True}
+                      for d in body["value"]]}
+        df = DataFrame({"id": np.array([str(i) for i in range(5)],
+                                       dtype=object),
+                        "content": np.array(list("abcde"), dtype=object)})
+        out = AddDocuments(url=url + "/docs", subscriptionKey="k",
+                           batchSize=2, outputCol="st").transform(df)
+        assert all(s["status"] for s in out["st"])
+        posts = [r for r in state["requests"] if r["path"] == "/docs"]
+        assert [len(p["body"]["value"]) for p in posts] == [2, 2, 1]
+        # every doc got the default upload action verb
+        assert all(d["@search.action"] == "upload"
+                   for p in posts for d in p["body"]["value"])
+
+    def test_writer_creates_index_then_uploads(self, server):
+        url, state = server
+        state["replies"]["/indexes/people"] = {"name": "people"}
+        state["replies"]["/indexes/people/docs/index"] = lambda body: {
+            "value": [{"key": d["id"], "status": True}
+                      for d in body["value"]]}
+        df = DataFrame({"id": np.array(["1", "2"], dtype=object)})
+        AzureSearchWriter.write(
+            df, url, key="k",
+            index_json=json.dumps({"name": "people", "fields": [
+                {"name": "id", "type": "Edm.String", "key": True}]}))
+        methods = [(r["method"], r["path"].split("?")[0])
+                   for r in state["requests"]]
+        assert ("PUT", "/indexes/people") == methods[0]
+        assert methods[1] == ("POST", "/indexes/people/docs/index")
+
+    def test_fatal_errors_raise(self, server):
+        url, state = server
+        state["replies"]["/docs"] = {"value": [
+            {"key": "1", "status": False, "errorMessage": "boom"}]}
+        df = DataFrame({"id": np.array(["1"], dtype=object)})
+        with pytest.raises(RuntimeError, match="boom"):
+            AddDocuments(url=url + "/docs", outputCol="st").transform(df)
+
+
+class TestSpeech:
+    def test_one_shot_recognition(self, server):
+        url, state = server
+        state["replies"]["/stt"] = {"RecognitionStatus": "Success",
+                                    "DisplayText": "hello world"}
+        audio = np.sin(np.linspace(0, 1, 1600)).astype(np.float32)
+        df = DataFrame({"audio": [audio]})
+        out = SpeechToText(url=url + "/stt", subscriptionKey="k",
+                           outputCol="t").transform(df)
+        assert out["t"][0] == "hello world"
+        req = state["requests"][-1]
+        assert req["headers"].get("Content-Type") == "audio/wav"
+        assert "language=en-US" in req["path"]
+
+    def test_sdk_streams_chunks_and_collects_segments(self, server):
+        url, state = server
+        counter = {"n": 0}
+
+        def reply(_body):
+            counter["n"] += 1
+            return {"DisplayText": f"seg{counter['n']}"}
+        state["replies"]["/stt"] = reply
+        # 2 bytes/sample * 16kHz * 250ms chunks over 1s audio -> 4 chunks
+        audio = bytes(2 * 16000)
+        df = DataFrame({"audio": np.array([audio], dtype=object)})
+        out = SpeechToTextSDK(url=url + "/stt", chunkMs=250,
+                              outputCol="segs").transform(df)
+        assert out["segs"][0] == ["seg1", "seg2", "seg3", "seg4"]
+        joined = SpeechToTextSDK(url=url + "/stt", chunkMs=250,
+                                 streamIntermediateResults=False,
+                                 outputCol="txt").transform(df)
+        assert joined["txt"][0] == "seg5 seg6 seg7 seg8"
+
+    def test_text_to_speech_returns_audio(self, server):
+        url, state = server
+        state["replies"]["/tts"] = b"RIFFfakeaudio"
+        df = DataFrame({"text": np.array(["say this"], dtype=object)})
+        out = TextToSpeech(url=url + "/tts", outputCol="audio").transform(df)
+        assert out["audio"][0] == b"RIFFfakeaudio"
+        body = state["requests"][-1]["body"]
+        assert b"say this" in body and b"JennyNeural" in body
+
+
+class TestBingAndGeospatial:
+    def test_bing_image_search(self, server):
+        url, state = server
+        state["replies"]["/v7.0/images/search"] = {"value": [
+            {"contentUrl": "http://img/1.png", "name": "one"},
+            {"contentUrl": "http://img/2.png", "name": "two"}]}
+        df = DataFrame({"q": np.array(["cats", "dogs"], dtype=object)})
+        out = BingImageSearch(url=url + "/v7.0/images/search", count=2,
+                              outputCol="imgs").transform(df)
+        assert out["imgs"][0][0]["contentUrl"] == "http://img/1.png"
+        assert "q=cats" in state["requests"][0]["path"]
+        urls = BingImageSearch.downloads_from_results(out["imgs"])
+        assert len(urls) == 4
+
+    def test_geocoders_and_geofence(self, server):
+        url, state = server
+        state["replies"]["/geo"] = {"results": [
+            {"position": {"lat": 47.6, "lon": -122.1}}]}
+        df = DataFrame({"address": np.array(["1 Main St"], dtype=object)})
+        out = AddressGeocoder(url=url + "/geo",
+                              outputCol="pos").transform(df)
+        assert out["pos"][0] == {"lat": 47.6, "lon": -122.1}
+
+        state["replies"]["/rev"] = {"addresses": [
+            {"address": {"streetName": "Main St"}}]}
+        from mmlspark_tpu.io import ReverseAddressGeocoder
+        df2 = DataFrame({"lat": np.array([47.6]),
+                         "lon": np.array([-122.1])})
+        out2 = ReverseAddressGeocoder(url=url + "/rev",
+                                      outputCol="addr").transform(df2)
+        assert out2["addr"][0]["streetName"] == "Main St"
+
+        state["replies"]["/fence"] = {"result": {"pointInPolygons": True}}
+        out3 = CheckPointInPolygon(url=url + "/fence",
+                                   userDataIdentifier="udid-1",
+                                   outputCol="inside").transform(df2)
+        assert out3["inside"][0] is True
+        assert state["requests"][-1]["body"]["udid"] == "udid-1"
