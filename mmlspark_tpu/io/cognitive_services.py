@@ -388,6 +388,7 @@ class AddDocuments(CognitiveServiceTransformer):
                 doc = {k: v for k, v in doc.items() if v is not None}
             docs.append(doc)
         statuses = np.empty(len(docs), dtype=object)
+        errors = np.empty(len(docs), dtype=object)
         headers = {"Content-Type": "application/json", **self._headers()}
         bs = self.get("batchSize")
         for start in range(0, len(docs), bs):
@@ -400,11 +401,15 @@ class AddDocuments(CognitiveServiceTransformer):
             for j, st in enumerate(reply.get("value", [])):
                 if start + j < len(statuses):
                     statuses[start + j] = st
+                    if not st.get("status", True):
+                        errors[start + j] = st.get("errorMessage",
+                                                   "upload failed")
                 if self.get("fatalErrors") and not st.get("status", True):
                     raise RuntimeError(
                         f"index upload failed for key "
                         f"{st.get('key')!r}: {st.get('errorMessage')}")
-        return dataset.with_column(self.get("outputCol"), statuses)
+        return (dataset.with_column(self.get("outputCol"), statuses)
+                .with_column(self.get("errorCol"), errors))
 
 
 class AzureSearchWriter:
@@ -421,26 +426,27 @@ class AzureSearchWriter:
         import urllib.error
         import urllib.request
 
+        docs_url = url
         if index_json:
             spec = _json.loads(index_json)
-            name = spec["name"]
-            req = urllib.request.Request(
-                f"{url.rstrip('/')}/indexes/{name}",
-                data=_json.dumps(spec).encode(), method="PUT",
-                headers={"Content-Type": "application/json",
-                         "api-key": key})
-            try:
-                urllib.request.urlopen(req, timeout=timeout).close()
-            except urllib.error.HTTPError as e:
-                if e.code != 409:  # already exists
-                    raise
-            docs_url = f"{url.rstrip('/')}/indexes/{name}/docs/index"
-        else:
-            docs_url = url
+            docs_url = (f"{url.rstrip('/')}/indexes/{spec['name']}"
+                        "/docs/index")
         stage = AddDocuments(url=docs_url, subscriptionKey=key,
                              batchSize=batch_size, actionCol=action_col,
                              fatalErrors=fatal_errors, timeout=timeout,
                              outputCol="indexStatus")
+        if index_json:
+            # index creation shares the document-upload retry policy
+            req = urllib.request.Request(
+                f"{url.rstrip('/')}/indexes/{spec['name']}",
+                data=_json.dumps(spec).encode(), method="PUT",
+                headers={"Content-Type": "application/json",
+                         "api-key": key})
+            try:
+                stage._open_retrying(req).close()
+            except urllib.error.HTTPError as e:
+                if e.code != 409:  # already exists
+                    raise
         return stage.transform(df)
 
 

@@ -197,7 +197,10 @@ class TestBingAndGeospatial:
         out = BingImageSearch(url=url + "/v7.0/images/search", count=2,
                               outputCol="imgs").transform(df)
         assert out["imgs"][0][0]["contentUrl"] == "http://img/1.png"
-        assert "q=cats" in state["requests"][0]["path"]
+        # rows run concurrently: arrival order is unordered
+        queries = {r["path"].split("q=")[1].split("&")[0]
+                   for r in state["requests"]}
+        assert queries == {"cats", "dogs"}
         urls = BingImageSearch.downloads_from_results(out["imgs"])
         assert len(urls) == 4
 
