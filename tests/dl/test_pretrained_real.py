@@ -144,3 +144,41 @@ def test_deep_vision_fine_tune_from_checkpoint(hub, tmp_path):
     loaded = PipelineStage.load(path)
     np.testing.assert_array_equal(
         np.asarray(loaded.transform(tdf)["prediction"]), pred)
+
+
+def test_deep_text_fine_tune_from_checkpoint(hub):
+    """DeepTextClassifier starts from the committed trained text
+    encoder (the HF-checkpoint fine-tune analog,
+    hf/HuggingFaceSentenceEmbedder.py:26-60) and classifies topics it
+    was never directly trained to label."""
+    from mmlspark_tpu.dl.text import DeepTextClassifier
+    from tools.train_tiny_encoders import TOPICS, FILLER
+
+    rng = np.random.default_rng(8)
+    names = sorted(TOPICS)[:3]
+    texts, labels = [], []
+    for li, t in enumerate(names):
+        for _ in range(60):
+            ws = list(rng.choice(TOPICS[t], size=6)) + \
+                list(rng.choice(FILLER, size=2))
+            rng.shuffle(ws)
+            texts.append(" ".join(ws))
+            labels.append(float(li))
+    df = DataFrame({"text": np.array(texts, dtype=object),
+                    "label": np.array(labels)})
+    backbone = os.path.join(HUB_DIR, "tiny-text-encoder.onnx")
+    clf = DeepTextClassifier(backboneFile=backbone, textCol="text",
+                             labelCol="label", maxLength=16,
+                             vocabSize=2048, batchSize=32, maxEpochs=6,
+                             learningRate=5e-3).fit(df)
+    # held-out topic sentences classify correctly
+    ht, hy = [], []
+    for li, t in enumerate(names):
+        for _ in range(20):
+            ws = list(rng.choice(TOPICS[t], size=6))
+            ht.append(" ".join(ws))
+            hy.append(li)
+    pred = np.asarray(clf.transform(
+        DataFrame({"text": np.array(ht, dtype=object)}))["prediction"])
+    acc = float((pred == np.asarray(hy)).mean())
+    assert acc > 0.85, f"fine-tuned text acc {acc:.3f}"

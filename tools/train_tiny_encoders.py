@@ -21,10 +21,6 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from mmlspark_tpu.core.virtual_devices import force_cpu_devices  # noqa: E402
-
-force_cpu_devices(1)
-
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
@@ -68,6 +64,32 @@ def make_corpus(rng, n_per_topic=400):
             texts.append(" ".join(words))
             topics.append(t)
     return texts, np.asarray(topics)
+
+
+
+
+def _add_initializer(g, name, arr, dtype=1):
+    t = g.initializer.add()
+    t.name = name
+    t.data_type = dtype
+    t.dims.extend(list(arr.shape))
+    t.raw_data = np.ascontiguousarray(arr, np.float32).tobytes()
+
+
+def _add_node(g, op, inputs, outputs, **attrs):
+    nd = g.node.add()
+    nd.op_type = op
+    nd.input.extend(inputs)
+    nd.output.extend(outputs)
+    for k, v in attrs.items():
+        a = nd.attribute.add()
+        a.name = k
+        if isinstance(v, int):
+            a.type = 2
+            a.i = v
+        elif isinstance(v, (list, tuple)):
+            a.type = 7
+            a.ints.extend(v)
 
 
 # ---------------------------------------------------------------------------
@@ -143,43 +165,21 @@ def export_text_onnx(params) -> bytes:
     g = model.graph
     g.name = "tiny_text_encoder"
 
-    def init(name, arr, dtype=1):
-        t = g.initializer.add()
-        t.name = name
-        t.data_type = dtype
-        t.dims.extend(list(arr.shape))
-        t.raw_data = np.ascontiguousarray(arr, np.float32).tobytes()
-
     inp = g.input.add()
     inp.name = "ids"
     inp.type.tensor_type.elem_type = 6  # int32
     for d in (0, MAX_LEN):
         inp.type.tensor_type.shape.dim.add().dim_value = d
 
-    init("table", params["table"])
-    init("proj", params["proj"])
-    init("bias", params["bias"])
+    _add_initializer(g, "table", params["table"])
+    _add_initializer(g, "proj", params["proj"])
+    _add_initializer(g, "bias", params["bias"])
 
-    def node(op, inputs, outputs, **attrs):
-        nd = g.node.add()
-        nd.op_type = op
-        nd.input.extend(inputs)
-        nd.output.extend(outputs)
-        for k, v in attrs.items():
-            a = nd.attribute.add()
-            a.name = k
-            if isinstance(v, int):
-                a.type = 2
-                a.i = v
-            elif isinstance(v, (list, tuple)):
-                a.type = 7
-                a.ints.extend(v)
-
-    node("Gather", ["table", "ids"], ["emb"], axis=0)
-    node("ReduceMean", ["emb"], ["pooled"], axes=[1], keepdims=0)
-    node("MatMul", ["pooled", "proj"], ["mm"])
-    node("Add", ["mm", "bias"], ["pre"])
-    node("Tanh", ["pre"], ["embedding"])
+    _add_node(g, "Gather", ["table", "ids"], ["emb"], axis=0)
+    _add_node(g, "ReduceMean", ["emb"], ["pooled"], axes=[1], keepdims=0)
+    _add_node(g, "MatMul", ["pooled", "proj"], ["mm"])
+    _add_node(g, "Add", ["mm", "bias"], ["pre"])
+    _add_node(g, "Tanh", ["pre"], ["embedding"])
 
     out = g.output.add()
     out.name = "embedding"
@@ -279,48 +279,25 @@ def export_vision_onnx(params) -> bytes:
     g = model.graph
     g.name = "tiny_vision_encoder"
 
-    def init(name, arr):
-        t = g.initializer.add()
-        t.name = name
-        t.data_type = 1
-        t.dims.extend(list(arr.shape))
-        t.raw_data = np.ascontiguousarray(arr, np.float32).tobytes()
-
     inp = g.input.add()
     inp.name = "image"
     inp.type.tensor_type.elem_type = 1
     for d in (0, 1, IMG, IMG):
         inp.type.tensor_type.shape.dim.add().dim_value = d
 
-    init("c1", params["c1"])
-    init("b1", params["b1"])
-    init("c2", params["c2"])
-    init("b2", params["b2"])
+    for nm in ("c1", "b1", "c2", "b2"):
+        _add_initializer(g, nm, params[nm])
 
-    def node(op, inputs, outputs, **attrs):
-        nd = g.node.add()
-        nd.op_type = op
-        nd.input.extend(inputs)
-        nd.output.extend(outputs)
-        for k, v in attrs.items():
-            a = nd.attribute.add()
-            a.name = k
-            if isinstance(v, int):
-                a.type = 2
-                a.i = v
-            elif isinstance(v, (list, tuple)):
-                a.type = 7
-                a.ints.extend(v)
-
-    node("Conv", ["image", "c1", "b1"], ["h1"], kernel_shape=[3, 3],
-         strides=[1, 1], pads=[1, 1, 1, 1])
-    node("Relu", ["h1"], ["r1"])
-    node("MaxPool", ["r1"], ["p1"], kernel_shape=[2, 2], strides=[2, 2])
-    node("Conv", ["p1", "c2", "b2"], ["h2"], kernel_shape=[3, 3],
-         strides=[1, 1], pads=[1, 1, 1, 1])
-    node("Relu", ["h2"], ["r2"])
-    node("GlobalAveragePool", ["r2"], ["gap"])
-    node("Flatten", ["gap"], ["features"], axis=1)
+    _add_node(g, "Conv", ["image", "c1", "b1"], ["h1"],
+              kernel_shape=[3, 3], strides=[1, 1], pads=[1, 1, 1, 1])
+    _add_node(g, "Relu", ["h1"], ["r1"])
+    _add_node(g, "MaxPool", ["r1"], ["p1"], kernel_shape=[2, 2],
+              strides=[2, 2])
+    _add_node(g, "Conv", ["p1", "c2", "b2"], ["h2"], kernel_shape=[3, 3],
+              strides=[1, 1], pads=[1, 1, 1, 1])
+    _add_node(g, "Relu", ["h2"], ["r2"])
+    _add_node(g, "GlobalAveragePool", ["r2"], ["gap"])
+    _add_node(g, "Flatten", ["gap"], ["features"], axis=1)
 
     out = g.output.add()
     out.name = "features"
@@ -331,6 +308,10 @@ def export_vision_onnx(params) -> bytes:
 
 
 def main():
+    # force CPU here, NOT at import time: tests import this module for
+    # its corpus/renderer and must not downgrade their own device count
+    from mmlspark_tpu.core.virtual_devices import force_cpu_devices
+    force_cpu_devices(1)
     hub = ONNXHub(HUB_DIR)
     text_params = train_text()
     text_payload = export_text_onnx(text_params)
