@@ -29,6 +29,7 @@ params/LightGBMParams.scala; voting/feature parallel variants live in
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass, field, replace
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -252,7 +253,8 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
     return hist.reshape(width, f, b, 3)
 
 
-def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
+def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
+                    subtract: bool = False):
     """Compile-once tree builder: (binned, grad, hess, valid, feat_mask,
     remaining_leaves) -> (split_feature, threshold_bin, node_value, count,
     decision_type, bin_go_left).
@@ -263,6 +265,14 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
     route left. Numerical splits fill it with ``bin <= threshold``;
     categorical splits with the chosen category subset, so row routing
     and binned prediction are a single gather regardless of split type.
+
+    ``subtract=True`` enables LightGBM's histogram-subtraction trick
+    (feature_histogram.hpp Subtract): below the root, only the SMALLER
+    child of each split is histogrammed (its rows compacted to a static
+    N/2 buffer via sized nonzero) and the sibling is derived as
+    parent - smaller. Histogram row-work per tree drops from N*D to
+    ~N*(1 + (D-1)/2). Single-program only: the compaction gather is
+    data-dependent, so sharded (GSPMD) builders keep the full pass.
 
     Categorical features (``cfg.categorical_features``) follow LightGBM's
     algorithm (core/schema/Categoricals.scala; LightGBM's
@@ -317,6 +327,15 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
         n = binned.shape[0]
         f = num_features
         b = total_bins
+        if subtract:
+            # +1 dummy slot: sized-nonzero fill target for the
+            # smaller-child compaction gather
+            n_half = n // 2 + 1
+            binned_pad = jnp.concatenate(
+                [binned, jnp.zeros((1, f), binned.dtype)])
+            grad_pad = jnp.concatenate([grad, jnp.zeros(1, grad.dtype)])
+            hess_pad = jnp.concatenate([hess, jnp.zeros(1, hess.dtype)])
+            prev_hist = prev_split = prev_ss = None
 
         node = jnp.zeros(n, dtype=jnp.int32)       # slot in full layout
         done = jnp.zeros(n, dtype=jnp.bool_)        # settled in a leaf
@@ -350,8 +369,37 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
             live = (~done).astype(grad.dtype) * valid
 
             # --- histogram --------------------------------------------
-            hist = _level_histogram(binned, grad, hess, live, local,
-                                    width, f, b)
+            if subtract and d > 0:
+                # smaller child only; sibling by subtraction
+                par_row = local // 2
+                side = (local % 2).astype(jnp.int32)
+                sel = (live > 0) & (side == prev_ss[par_row])
+                idx = jnp.nonzero(sel, size=n_half, fill_value=n)[0]
+                live_pad = jnp.concatenate([live,
+                                            jnp.zeros(1, live.dtype)])
+                local_pad = jnp.concatenate(
+                    [local, jnp.zeros(1, local.dtype)])
+                hist_small = _level_histogram(
+                    binned_pad[idx], grad_pad[idx], hess_pad[idx],
+                    live_pad[idx], local_pad[idx], width, f, b)
+                kids = jnp.arange(width)
+                par_idx = kids // 2
+                is_small = (kids % 2) == prev_ss[par_idx]
+                sib = hist_small[kids ^ 1]
+                parent_h = prev_hist[par_idx]
+                hist = jnp.where(
+                    is_small[:, None, None, None], hist_small,
+                    jnp.where(prev_split[par_idx][:, None, None, None],
+                              parent_h - sib, 0.0))
+                # float cancellation can leave tiny negative counts /
+                # hessians on the derived side; clamp for the guards
+                hist = hist.at[..., 1].max(0.0)
+                hist = hist.at[..., 2].max(0.0)
+            else:
+                hist = _level_histogram(binned, grad, hess, live, local,
+                                        width, f, b)
+            if subtract:
+                prev_hist = hist
 
             # --- numerical split finding: ordered cumulative scan -------
             cum = jnp.cumsum(hist, axis=2)              # left stats per bin
@@ -548,6 +596,12 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig):
             node_count = node_count.at[rslots].set(
                 jnp.where(do_split, right_stats[:, 2], 0.0))
 
+            if subtract:
+                prev_split = do_split
+                prev_ss = jnp.where(
+                    left_stats[:, 2] <= right_stats[:, 2], 0, 1
+                ).astype(jnp.int32)
+
             # --- route rows ---------------------------------------------
             nfeat = best_feat[local]
             nbin = jnp.take_along_axis(binned, nfeat[:, None], 1)[:, 0]
@@ -676,7 +730,8 @@ def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
                                                  mesh),
                 total_bins)
         else:
-            fn = make_build_tree(num_f, total_bins, cfg)
+            fn = make_build_tree(num_f, total_bins, cfg,
+                                 subtract=subtract)
         return jax.jit(fn)
 
     if mode in ("voting", "feature") and cfg.categorical_features:
@@ -697,11 +752,20 @@ def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
     from mmlspark_tpu.models.gbdt.hist_pallas import (
         pallas_histogram_enabled,
     )
+    # histogram subtraction needs single-program semantics (the row
+    # compaction is a data-dependent gather); sharded modes keep the
+    # full per-level pass. Opt-in: on CPU the compaction overhead beats
+    # the saved histogram rows (measured 1.287 vs 1.548 Mrow-trees/s at
+    # bench shape, ROUND4_NOTES.md); the TPU pallas kernel's cost is
+    # row-proportional, so re-measure there before defaulting.
+    subtract = (mode == "serial"
+                and bool(os.environ.get("MMLSPARK_TPU_HIST_SUB")))
     # the histogram backend is chosen at trace time, so it must key the
-    # compiled-builder cache or flipping the env flag is silently ignored
+    # compiled-builder cache or flipping env flags is silently ignored
     return _cache_put(
         _BUILDER_CACHE,
-        (num_f, total_bins, cfg, mode, mesh, pallas_histogram_enabled()),
+        (num_f, total_bins, cfg, mode, mesh, pallas_histogram_enabled(),
+         subtract),
         build)
 
 
@@ -898,7 +962,8 @@ def _get_step_fn(num_f, total_bins, cfg, k, n_valid, mode, mesh):
 
     cfg = _loop_only_normalized(cfg)
     key = (num_f, total_bins, cfg, k, n_valid, mode, mesh,
-           pallas_histogram_enabled())
+           pallas_histogram_enabled(),
+           bool(os.environ.get("MMLSPARK_TPU_HIST_SUB")))
     return _cache_put(_CHUNK_CACHE, key,
                       lambda: _make_step_fn(num_f, total_bins, cfg, k,
                                             n_valid, mode, mesh))

@@ -16,7 +16,7 @@ from typing import Any, List
 import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame
-from mmlspark_tpu.core.param import Param, gt, to_bool, to_int
+from mmlspark_tpu.core.param import Param, gt, to_bool, to_int, to_str
 from mmlspark_tpu.core.pipeline import Transformer
 
 
@@ -71,18 +71,42 @@ class DynamicMiniBatchTransformer(Transformer):
 
 
 class TimeIntervalMiniBatchTransformer(Transformer):
-    """Parity stub for the streaming time-interval batcher
-    (stages/MiniBatchTransformer.scala): on a bounded columnar dataset it
-    degenerates to maxBatchSize batching."""
+    """Time-interval batcher (stages/MiniBatchTransformer.scala): rows
+    arriving within one ``millisToWait`` window form a batch.
 
-    millisToWait = Param("millisToWait", "interval between batches", to_int,
+    The reference batches by ARRIVAL time off a stream; the columnar
+    analog batches by EVENT time: ``timestampCol`` (epoch millis, or any
+    monotone numeric clock) assigns each row to the window
+    ``(ts - ts[0]) // millisToWait``, consecutive same-window rows
+    group into one batch, and ``maxBatchSize`` splits oversized
+    windows — identical batch boundaries to replaying the rows against
+    a wall clock. Without a timestamp column a bounded frame has a
+    single arrival instant, so everything lands in one capped batch
+    (the documented degenerate)."""
+
+    millisToWait = Param("millisToWait", "window length (ms)", to_int,
                          gt(0), default=1000)
     maxBatchSize = Param("maxBatchSize", "max rows per batch", to_int,
-                         default=2147483647)
+                         gt(0), default=2147483647)
+    timestampCol = Param("timestampCol", "event-time column (epoch ms)",
+                         to_str)
 
     def _transform(self, dataset: DataFrame) -> DataFrame:
-        return DynamicMiniBatchTransformer(
-            maxBatchSize=self.get("maxBatchSize")).transform(dataset)
+        cap = self.get("maxBatchSize")
+        n = dataset.num_rows
+        if not self.is_set("timestampCol") or n == 0:
+            return DynamicMiniBatchTransformer(
+                maxBatchSize=cap).transform(dataset)
+        ts = np.asarray(dataset.col(self.get("timestampCol")),
+                        dtype=np.float64)
+        window = np.floor((ts - ts[0]) / self.get("millisToWait"))
+        bounds = [0]
+        for i in range(1, n):
+            if (window[i] != window[i - 1]
+                    or i - bounds[-1] >= cap):
+                bounds.append(i)
+        bounds.append(n)
+        return _batch_df(dataset, sorted(set(bounds)))
 
 
 class FlattenBatch(Transformer):

@@ -119,3 +119,32 @@ def test_trainer_env_flag_routes_to_pallas(monkeypatch):
     p0 = np.asarray(base.booster.predict_jit()(x))
     p1 = np.asarray(swapped.booster.predict_jit()(x))
     np.testing.assert_allclose(p0, p1, rtol=1e-4, atol=1e-4)
+
+
+def test_histogram_subtraction_matches_full(monkeypatch):
+    """MMLSPARK_TPU_HIST_SUB=1 derives sibling histograms by
+    subtraction (LightGBM's trick); models must match the full
+    formulation to float-cancellation tolerance."""
+    from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
+    from mmlspark_tpu.ops.binning import BinMapper
+
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(3000, 6))
+    y = (x[:, 0] * x[:, 1] + 0.3 * x[:, 2]
+         + 0.1 * rng.normal(size=3000) > 0).astype(np.float64)
+    mapper = BinMapper.fit(x, max_bin=64)
+    binned = mapper.transform(x)
+    bu = mapper.bin_upper_values(64)
+    # deep-ish trees + bagging exercise dead branches and live masks
+    cfg = TrainConfig(objective="binary", num_iterations=6, num_leaves=31,
+                      max_depth=5, min_data_in_leaf=10, max_bin=64,
+                      bagging_fraction=0.8, bagging_freq=1)
+    base = train(binned, y, cfg, bin_upper=bu)
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_SUB", "1")
+    sub = train(binned, y, cfg, bin_upper=bu)
+    p0 = np.asarray(base.booster.predict_jit()(x))
+    p1 = np.asarray(sub.booster.predict_jit()(x))
+    np.testing.assert_allclose(p0, p1, rtol=1e-3, atol=1e-3)
+    # identical structure on well-separated early splits
+    assert (base.booster.split_feature[:, 0]
+            == sub.booster.split_feature[:, 0]).all()

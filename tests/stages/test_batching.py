@@ -1,0 +1,30 @@
+"""Time-interval mini-batching (stages/MiniBatchTransformer.scala)."""
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+
+
+def test_time_interval_batches_by_event_time():
+    from mmlspark_tpu.stages.batching import (
+        FlattenBatch, TimeIntervalMiniBatchTransformer)
+
+    # three 100ms windows: [0,40,90], [120,130], [250]; cap splits the
+    # first window after 2 rows
+    ts = np.array([0.0, 40.0, 90.0, 120.0, 130.0, 250.0])
+    x = np.arange(6.0)
+    df = DataFrame({"ts": ts, "x": x})
+    out = TimeIntervalMiniBatchTransformer(
+        millisToWait=100, timestampCol="ts").transform(df)
+    sizes = [len(v) for v in out["x"]]
+    assert sizes == [3, 2, 1]
+    capped = TimeIntervalMiniBatchTransformer(
+        millisToWait=100, timestampCol="ts",
+        maxBatchSize=2).transform(df)
+    assert [len(v) for v in capped["x"]] == [2, 1, 2, 1]
+    # FlattenBatch round-trips
+    flat = FlattenBatch().transform(out)
+    np.testing.assert_array_equal(np.asarray(flat["x"]), x)
+    # degenerate without a timestamp column: one capped batch
+    plain = TimeIntervalMiniBatchTransformer().transform(df)
+    assert [len(v) for v in plain["x"]] == [6]

@@ -12,6 +12,7 @@ Run: python tools/bench_serving.py [n_requests] [--cpu]
 """
 
 import json
+import math
 import os
 import sys
 import time
@@ -78,7 +79,7 @@ def main():
     import jax
     print(json.dumps({
         "p50_ms": round(lat[len(lat) // 2], 3),
-        "p99_ms": round(lat[int(len(lat) * 0.99) - 1], 3),
+        "p99_ms": round(lat[max(0, math.ceil(0.99 * len(lat)) - 1)], 3),
         "model": "LightGBMClassifier 28f x 100 trees x 63 leaves",
         "backend": jax.default_backend(),
         "n_requests": n_req,

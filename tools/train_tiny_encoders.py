@@ -68,10 +68,10 @@ def make_corpus(rng, n_per_topic=400):
 
 
 
-def _add_initializer(g, name, arr, dtype=1):
+def _add_initializer(g, name, arr):
     t = g.initializer.add()
     t.name = name
-    t.data_type = dtype
+    t.data_type = 1  # float32 (the only initializer dtype we emit)
     t.dims.extend(list(arr.shape))
     t.raw_data = np.ascontiguousarray(arr, np.float32).tobytes()
 
