@@ -682,6 +682,42 @@ class BoosterArrays:
             decision_type=dt, cat_bitset=bitset,
         )
 
+    def slice_iterations(self, start_iteration: int = 0,
+                         num_iteration: int = -1) -> "BoosterArrays":
+        """Sub-ensemble over boosting iterations [start, start+num)
+        (LightGBM predict's start_iteration/num_iteration; trees are
+        interleaved per class, so iteration i owns trees
+        [i*K, (i+1)*K)). ``init_score`` stays included — it is a
+        separate additive constant here, not part of any iteration.
+        ``num_iteration <= 0`` means to the end (LightGBM predict semantics)."""
+        k = max(self.num_class, 1)
+        total = self.num_trees // k
+        if not 0 <= start_iteration <= total:
+            raise ValueError(
+                f"start_iteration {start_iteration} outside [0, {total}]")
+        # LightGBM predict semantics: num_iteration <= 0 selects all
+        stop = (total if num_iteration <= 0
+                else min(total, start_iteration + num_iteration))
+        sl = slice(start_iteration * k, stop * k)
+        return BoosterArrays(
+            split_feature=self.split_feature[sl],
+            threshold_bin=self.threshold_bin[sl],
+            threshold_value=self.threshold_value[sl],
+            node_value=self.node_value[sl],
+            count=self.count[sl],
+            tree_weights=self.tree_weights[sl],
+            max_depth=self.max_depth,
+            num_features=self.num_features,
+            num_class=self.num_class,
+            objective=self.objective,
+            init_score=self.init_score,
+            feature_names=self.feature_names,
+            decision_type=(None if self.decision_type is None
+                           else self.decision_type[sl]),
+            cat_bitset=(None if self.cat_bitset is None
+                        else self.cat_bitset[sl]),
+        )
+
     @staticmethod
     def concat(a: "BoosterArrays", b: "BoosterArrays") -> "BoosterArrays":
         """Concatenate ensembles (warm-start continuation): pad both to

@@ -643,11 +643,37 @@ class _LightGBMBase(Estimator, _LightGBMParams):
 class _LightGBMModelBase(Model, _LightGBMParams):
     """Shared transform/scoring (LightGBMModelMethods analog)."""
 
+    startIteration = Param(
+        "startIteration", "score with trees from this boosting "
+        "iteration on (LightGBM predict start_iteration)", to_int,
+        ge(0), default=0)
+    numIteration = Param(
+        "numIteration", "score with at most this many iterations from "
+        "startIteration (<0 = all; LightGBM predict num_iteration)",
+        to_int, default=-1)
+
     booster: Optional[BoosterArrays] = None
     train_measures: Optional[InstrumentationMeasures] = None
     evals_result: Optional[List[Dict[str, float]]] = None
     best_iteration: int = -1
     _mesh = None
+    _sliced_cache = None
+
+    @property
+    def scoring_booster(self) -> BoosterArrays:
+        """The booster restricted to [startIteration,
+        startIteration+numIteration) — the full ensemble when the
+        params are at their defaults."""
+        s = self.get("startIteration")
+        m = self.get("numIteration")
+        if s == 0 and m <= 0:
+            # LightGBM predict semantics: num_iteration <= 0 means all
+            return self.booster
+        key = (s, m, id(self.booster))
+        if self._sliced_cache is None or self._sliced_cache[0] != key:
+            self._sliced_cache = (
+                key, self.booster.slice_iterations(s, m))
+        return self._sliced_cache[1]
 
     def set_mesh(self, mesh) -> "_LightGBMModelBase":
         """Score with rows sharded over the mesh 'dp' axis (embarrassing
@@ -716,11 +742,11 @@ class _LightGBMModelBase(Model, _LightGBMParams):
 
     def _maybe_extra_cols(self, df: DataFrame, x: np.ndarray) -> DataFrame:
         if self.is_set("leafPredictionCol"):
-            leaves = self._score(self.booster.leaf_index_jit(), x)
+            leaves = self._score(self.scoring_booster.leaf_index_jit(), x)
             df = df.with_column(self.get("leafPredictionCol"),
                                 leaves.astype(np.float64))
         if self.is_set("featuresShapCol"):
-            contribs = self._score(self.booster.contrib_jit(), x)
+            contribs = self._score(self.scoring_booster.contrib_jit(), x)
             df = df.with_column(self.get("featuresShapCol"),
                                 contribs.astype(np.float64))
         return df
@@ -830,7 +856,7 @@ class LightGBMClassificationModel(_LightGBMModelBase):
         import jax.numpy as jnp
 
         x = self._features(df)
-        raw = self._score(self.booster.predict_jit(), x)
+        raw = self._score(self.scoring_booster.predict_jit(), x)
         if raw.ndim == 1:  # binary: margins for [neg, pos]
             raw2 = np.stack([-raw, raw], axis=1)
             prob = 1.0 / (1.0 + np.exp(-raw))
@@ -888,7 +914,7 @@ class LightGBMRegressor(_LightGBMBase):
 class LightGBMRegressionModel(_LightGBMModelBase):
     def _transform(self, df: DataFrame) -> DataFrame:
         x = self._features(df)
-        raw = self._score(self.booster.predict_jit(), x)
+        raw = self._score(self.scoring_booster.predict_jit(), x)
         if self.booster.objective in ("poisson", "gamma", "tweedie"):
             raw = np.exp(raw)
         out = df.with_column(self.get("predictionCol"), raw.astype(np.float64))
@@ -937,7 +963,7 @@ class LightGBMRanker(_LightGBMBase):
 class LightGBMRankerModel(_LightGBMModelBase):
     def _transform(self, df: DataFrame) -> DataFrame:
         x = self._features(df)
-        raw = self._score(self.booster.predict_jit(), x)
+        raw = self._score(self.scoring_booster.predict_jit(), x)
         out = df.with_column(self.get("predictionCol"), raw.astype(np.float64))
         return self._maybe_extra_cols(out, x)
 

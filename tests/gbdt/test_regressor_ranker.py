@@ -200,3 +200,27 @@ def test_custom_objective_host_numpy():
     pred = res.booster.predict_jit()(x)
     r2 = 1 - np.sum((np.asarray(pred) - y) ** 2) / np.sum((y - y.mean()) ** 2)
     assert r2 > 0.8, r2
+
+
+def test_start_iteration_prediction_slicing():
+    """LightGBM predict(start_iteration, num_iteration) analog: models
+    score with a sub-range of boosting iterations."""
+    rng = np.random.default_rng(21)
+    x = rng.normal(size=(800, 4))
+    y = 2.0 * x[:, 0] - x[:, 1] + rng.normal(size=800) * 0.1
+    df = DataFrame({"features": x, "label": y})
+    m = LightGBMRegressor(numIterations=10, numLeaves=8, maxBin=32).fit(df)
+    full = np.asarray(m.transform(df)["prediction"])
+    # first 4 iterations only
+    head = m.copy(numIteration=4)
+    p_head = np.asarray(head.transform(df)["prediction"])
+    # remaining 6: full = head + tail - init (init counted in both)
+    tail = m.copy(startIteration=4)
+    p_tail = np.asarray(tail.transform(df)["prediction"])
+    np.testing.assert_allclose(p_head + p_tail - m.booster.init_score,
+                               full, atol=1e-5)
+    assert not np.allclose(p_head, full)
+    # sub-range booster slices the tree arrays
+    assert m.booster.slice_iterations(4, 3).num_trees == 3
+    with pytest.raises(ValueError, match="start_iteration"):
+        m.booster.slice_iterations(99)
