@@ -8,6 +8,7 @@ HyperparamBuilder.scala:1, DefaultHyperparams.scala:1).
 from mmlspark_tpu.automl.hyperparams import (
     DiscreteHyperParam,
     GridSpace,
+    DefaultHyperparams,
     HyperparamBuilder,
     RandomSpace,
     RangeHyperParam,
@@ -20,6 +21,7 @@ from mmlspark_tpu.automl.search import (
 )
 
 __all__ = [
+    "DefaultHyperparams",
     "HyperparamBuilder", "DiscreteHyperParam", "RangeHyperParam",
     "GridSpace", "RandomSpace",
     "TuneHyperparameters", "TuneHyperparametersModel",

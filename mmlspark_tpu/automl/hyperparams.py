@@ -84,3 +84,43 @@ class RandomSpace:
     def param_maps(self) -> Iterator[Dict[str, Any]]:
         while True:
             yield {n: d.get_next() for n, d in self.space}
+
+
+class DefaultHyperparams:
+    """Good default sweep ranges per learner family
+    (automl/DefaultHyperparams.scala:13 — theirs covers SparkML
+    learners; here the framework's own estimators)."""
+
+    @staticmethod
+    def default_range(learner):
+        names = {base.__name__ for base in type(learner).__mro__}
+        name = type(learner).__name__
+        if names & {"LightGBMClassifier", "LightGBMRegressor",
+                    "LightGBMRanker"}:
+            return (HyperparamBuilder()
+                    .add_hyperparam("numLeaves", DiscreteHyperParam(
+                        [15, 31, 63]))
+                    .add_hyperparam("learningRate", RangeHyperParam(
+                        0.02, 0.2))
+                    .add_hyperparam("minDataInLeaf", DiscreteHyperParam(
+                        [5, 20, 50]))
+                    .add_hyperparam("featureFraction", RangeHyperParam(
+                        0.6, 1.0))
+                    .build())
+        if names & {"VowpalWabbitClassifier", "VowpalWabbitRegressor"}:
+            return (HyperparamBuilder()
+                    .add_hyperparam("learningRate", RangeHyperParam(
+                        0.05, 1.0))
+                    .add_hyperparam("numPasses", DiscreteHyperParam(
+                        [1, 3, 6]))
+                    .build())
+        if names & {"DeepVisionClassifier", "DeepTextClassifier"}:
+            return (HyperparamBuilder()
+                    .add_hyperparam("learningRate", RangeHyperParam(
+                        1e-4, 1e-2))
+                    .add_hyperparam("batchSize", DiscreteHyperParam(
+                        [32, 64, 128]))
+                    .build())
+        raise ValueError(
+            f"no default hyperparameter range for {name}; build one "
+            "with HyperparamBuilder")

@@ -263,8 +263,9 @@ class BoosterArrays:
         in f32 once covers get small). Duplicate path features merge
         multiplicatively; padded entries are (z=1, o=1), which is
         exactly neutral under the factorial weights, so every path can
-        be treated as length D. Multi-class trees are summed (one
-        combined column set, matching :meth:`contrib_saabas_fn`).
+        be treated as length D. Multi-class models return per-class
+        blocks ``(N, K*(F+1))`` — tree t contributes to class
+        ``t % K`` — matching LightGBM predict_contrib's layout.
         """
         import jax
         import jax.numpy as jnp
@@ -274,6 +275,9 @@ class BoosterArrays:
         ct = jnp.asarray(self.count)
         tw = jnp.asarray(self.tree_weights)
         depth, num_f = self.max_depth, self.num_features
+        # NOTE: the merge loop below reuses ``k`` as an index, so the
+        # class count gets an unshadowable name
+        n_cls = max(self.num_class, 1)
         m = self.num_nodes
         route = self._go_left_fn()
         anc_node, anc_child, anc_valid, is_left = self._ancestor_tables()
@@ -367,19 +371,23 @@ class BoosterArrays:
                     amount = amount * (u[i] >= 0)[None, :]
                     phi = phi.at[:, jnp.maximum(u[i], 0)].add(amount)
 
-                acc = acc.at[:, :num_f].add(phi)
-                acc = acc.at[:, num_f].add(base)
+                cls = tree_idx % n_cls
+                acc = acc.at[:, cls, :num_f].add(phi)
+                acc = acc.at[:, cls, num_f].add(base)
                 return acc, None
 
-            acc = jnp.zeros((n, num_f + 1), dtype=jnp.float32)
-            acc = acc.at[:, num_f].add(self.init_score)
+            acc = jnp.zeros((n, n_cls, num_f + 1), dtype=jnp.float32)
+            acc = acc.at[:, :, num_f].add(self.init_score)
             acc, _ = jax.lax.scan(one_tree, acc, jnp.arange(self.num_trees))
-            return acc
+            return (acc[:, 0] if n_cls == 1
+                    else acc.reshape(n, n_cls * (num_f + 1)))
 
         return contribs
 
     def contrib_saabas_fn(self):
-        """Per-feature contributions (N, F+1), last column = expected value.
+        """Per-feature contributions, last column of each block = the
+        expected value; multiclass returns per-class blocks
+        ``(N, K*(F+1))`` like :meth:`contrib_fn`.
 
         Saabas-style path attribution: each split credits
         value(child) - value(node) to its split feature — the cheap
@@ -392,7 +400,8 @@ class BoosterArrays:
         sf = jnp.asarray(self.split_feature)
         nv = jnp.asarray(self.node_value)
         tw = jnp.asarray(self.tree_weights)
-        depth, num_f, k = self.max_depth, self.num_features, self.num_class
+        depth, num_f = self.max_depth, self.num_features
+        k = max(self.num_class, 1)
         route = self._go_left_fn()
 
         def contribs(x):
@@ -415,14 +424,16 @@ class BoosterArrays:
                     upd = jnp.where(is_leaf, 0.0, delta)
                     c = c.at[jnp.arange(n), jnp.maximum(feat, 0)].add(upd)
                     node = child
-                acc = acc.at[:, :num_f].add(c)
-                acc = acc.at[:, num_f].add(base * tw[tree_idx])
+                cls = tree_idx % k
+                acc = acc.at[:, cls, :num_f].add(c)
+                acc = acc.at[:, cls, num_f].add(base * tw[tree_idx])
                 return acc, None
 
-            acc = jnp.zeros((n, num_f + 1), dtype=jnp.float32)
-            acc = acc.at[:, num_f].add(self.init_score)
+            acc = jnp.zeros((n, k, num_f + 1), dtype=jnp.float32)
+            acc = acc.at[:, :, num_f].add(self.init_score)
             acc, _ = jax.lax.scan(one_tree, acc, jnp.arange(self.num_trees))
-            return acc
+            return (acc[:, 0] if k == 1
+                    else acc.reshape(n, k * (num_f + 1)))
 
         return contribs
 

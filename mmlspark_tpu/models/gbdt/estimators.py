@@ -669,11 +669,12 @@ class _LightGBMModelBase(Model, _LightGBMParams):
         if s == 0 and m <= 0:
             # LightGBM predict semantics: num_iteration <= 0 means all
             return self.booster
-        key = (s, m, id(self.booster))
-        if self._sliced_cache is None or self._sliced_cache[0] != key:
+        key = (s, m)
+        if (self._sliced_cache is None or self._sliced_cache[0] != key
+                or self._sliced_cache[1] is not self.booster):
             self._sliced_cache = (
-                key, self.booster.slice_iterations(s, m))
-        return self._sliced_cache[1]
+                key, self.booster, self.booster.slice_iterations(s, m))
+        return self._sliced_cache[2]
 
     def set_mesh(self, mesh) -> "_LightGBMModelBase":
         """Score with rows sharded over the mesh 'dp' axis (embarrassing

@@ -241,4 +241,3 @@ def test_instrumentation_surfaces_from_fitted_model(rng):
     assert measures.get("binning", 0) > 0
     assert measures.get("training", 0) > 0
     assert model.train_measures.count("training") >= 3
-

@@ -132,3 +132,20 @@ def test_efficiency_on_imported_golden_model():
     raw = np.asarray(booster.predict_jit()(x))
     shap = np.asarray(booster.contrib_jit()(x))
     np.testing.assert_allclose(shap.sum(axis=1), raw, atol=1e-3)
+
+
+def test_multiclass_per_class_blocks():
+    """Multi-class contribs return (N, K*(F+1)) per-class blocks, each
+    block summing to that class's raw margin (LightGBM layout)."""
+    rng = np.random.default_rng(4)
+    n, f, k = 600, 4, 3
+    x = rng.normal(size=(n, f))
+    y = np.argmax(np.stack([x[:, 0], x[:, 1], x[:, 2]]), axis=0
+                  ).astype(np.float64)
+    res = _fit(x, y, objective="multiclass", num_iterations=4,
+               num_class=3)
+    raw = np.asarray(res.booster.predict_jit()(x))          # (N, K)
+    shap = np.asarray(res.booster.contrib_jit()(x))
+    assert shap.shape == (n, k * (f + 1))
+    blocks = shap.reshape(n, k, f + 1)
+    np.testing.assert_allclose(blocks.sum(axis=2), raw, atol=1e-3)

@@ -151,3 +151,24 @@ class TestAutoML:
         assert fbm.get_best_model() is strong
         metrics_df = fbm.get_all_model_metrics()
         assert metrics_df.num_rows == 2
+
+
+def test_default_hyperparams_sweep(rng):
+    """DefaultHyperparams.scala:13 analog: default sweep ranges drive
+    TuneHyperparameters without hand-building a space."""
+    from mmlspark_tpu.automl import DefaultHyperparams, TuneHyperparameters
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+    x = rng.normal(size=(400, 4))
+    y = (x[:, 0] > 0).astype(np.float64)
+    df = DataFrame({"features": x, "label": y})
+    learner = LightGBMClassifier(numIterations=5, maxBin=32)
+    space = DefaultHyperparams.default_range(learner)
+    assert {n for n, _ in space} >= {"numLeaves", "learningRate"}
+    tuned = TuneHyperparameters(models=[learner], paramSpace=space,
+                                numRuns=3, numFolds=2,
+                                evaluationMetric="AUC").fit(df)
+    pred = np.asarray(tuned.transform(df)["prediction"])
+    assert ((pred == y).mean()) > 0.8
+    with pytest.raises(ValueError, match="no default"):
+        DefaultHyperparams.default_range(object())
