@@ -117,9 +117,18 @@ def main():
     # compiled step)
     train(binned, y, cfg, bin_upper=bin_upper)
 
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR")
+    if profile_dir:
+        # one profiled steady-state run for op-level attribution
+        # (view with tensorboard or xprof; TPU-day triage shortcut)
+        import jax
+        jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
     result = train(binned, y, cfg, bin_upper=bin_upper)
     dt = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
+        print(f"# trace written to {profile_dir}", file=sys.stderr)
 
     row_trees_per_s = n * result.booster.num_trees / dt / 1e6
     print(json.dumps({

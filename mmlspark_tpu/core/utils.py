@@ -18,6 +18,16 @@ class RetriesExceededError(RuntimeError):
     pass
 
 
+def env_flag(name: str) -> bool:
+    """Truthy env-var opt-in: 1/true/yes/on (case-insensitive) enable;
+    anything else — including 'false', 'off', '0', unset — disables.
+    The shared semantics for the experimental-kernel flags
+    (MMLSPARK_TPU_PALLAS_HIST / _HIST_SUB / _FLASH)."""
+    import os
+    return os.environ.get(name, "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
 def retry_with_backoff(fn: Callable[[], Any], retries: int = 5,
                        initial_delay: float = 0.1, backoff: float = 2.0,
                        exceptions: Tuple[type, ...] = (Exception,),

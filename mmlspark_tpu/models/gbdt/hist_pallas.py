@@ -48,7 +48,8 @@ def pallas_histogram_enabled() -> bool:
     """Opt-in until a real-TPU measurement picks the default
     (bench_hist.py measures this kernel against the XLA formulations;
     ROUND4 notes record the decision)."""
-    return os.environ.get("MMLSPARK_TPU_PALLAS_HIST", "") not in ("", "0")
+    from mmlspark_tpu.core.utils import env_flag
+    return env_flag("MMLSPARK_TPU_PALLAS_HIST")
 
 
 def _hist_kernel(bn_ref, bins_ref, data_ref, out_ref, *, num_features: int,

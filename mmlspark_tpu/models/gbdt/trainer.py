@@ -758,8 +758,8 @@ def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
     # the saved histogram rows (measured 1.287 vs 1.548 Mrow-trees/s at
     # bench shape, ROUND4_NOTES.md); the TPU pallas kernel's cost is
     # row-proportional, so re-measure there before defaulting.
-    subtract = (mode == "serial"
-                and bool(os.environ.get("MMLSPARK_TPU_HIST_SUB")))
+    from mmlspark_tpu.core.utils import env_flag
+    subtract = mode == "serial" and env_flag("MMLSPARK_TPU_HIST_SUB")
     # the histogram backend is chosen at trace time, so it must key the
     # compiled-builder cache or flipping env flags is silently ignored
     return _cache_put(
@@ -961,9 +961,9 @@ def _get_step_fn(num_f, total_bins, cfg, k, n_valid, mode, mesh):
     )
 
     cfg = _loop_only_normalized(cfg)
+    from mmlspark_tpu.core.utils import env_flag
     key = (num_f, total_bins, cfg, k, n_valid, mode, mesh,
-           pallas_histogram_enabled(),
-           bool(os.environ.get("MMLSPARK_TPU_HIST_SUB")))
+           pallas_histogram_enabled(), env_flag("MMLSPARK_TPU_HIST_SUB"))
     return _cache_put(_CHUNK_CACHE, key,
                       lambda: _make_step_fn(num_f, total_bins, cfg, k,
                                             n_valid, mode, mesh))

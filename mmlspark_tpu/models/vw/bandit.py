@@ -63,11 +63,11 @@ class VowpalWabbitContextualBandit(_VWBaseLearner):
             raise ValueError("feature indices exceed numBits hash space; "
                              "featurizer and learner numBits must match")
         # one weight bank per action: shift hashed indices by action block
-        run = make_sgd_train(num_weights * num_actions, "squared",
-                             get("learningRate"), get("powerT"),
-                             get("initialT"), get("adaptive"), get("l1"),
-                             get("l2"))
-        run = jax.jit(run)
+        from mmlspark_tpu.models.vw.learners import jitted_sgd_train
+        run = jitted_sgd_train(num_weights * num_actions, "squared",
+                               get("learningRate"), get("powerT"),
+                               get("initialT"), get("adaptive"),
+                               get("l1"), get("l2"))
         shifted = (idx.astype(np.int64)
                    + (action[:, None] * num_weights)).astype(np.int64)
         bidx, bval, by, bwt = _batchify(shifted, val, cost, wt, get("batchSize"))

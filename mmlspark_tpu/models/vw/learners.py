@@ -61,6 +61,20 @@ def _loss_grad(loss: str, pred, y, quantile_tau: float = 0.5):
     raise ValueError(f"unknown loss {loss!r}")
 
 
+_SGD_JIT_CACHE = {}
+
+
+def jitted_sgd_train(*args, **kwargs):
+    """``jax.jit(make_sgd_train(...))`` memoized by config: repeated
+    fits with the same hyperparameters reuse one traced+compiled
+    update function instead of re-tracing per fit."""
+    import jax
+    key = (args, tuple(sorted(kwargs.items())))
+    if key not in _SGD_JIT_CACHE:
+        _SGD_JIT_CACHE[key] = jax.jit(make_sgd_train(*args, **kwargs))
+    return _SGD_JIT_CACHE[key]
+
+
 def make_sgd_train(num_weights: int, loss: str, learning_rate: float,
                    power_t: float, initial_t: float, adaptive: bool,
                    l1: float, l2: float, quantile_tau: float = 0.5,
@@ -265,7 +279,11 @@ class _VWBaseLearner(Estimator, _VWParams):
                           batch_spec, batch_spec),
                 out_specs=(P(), P(), P(), P(), batch_spec)))
         else:
-            run_pass = jax.jit(run)
+            run_pass = jitted_sgd_train(
+                num_weights, self._loss, get("learningRate"),
+                get("powerT"), get("initialT"), get("adaptive"),
+                get("l1"), get("l2"), quantile_tau=0.5,
+                progressive=progressive)
         w = jnp.zeros(num_weights, dtype=jnp.float32)
         g2 = jnp.zeros(num_weights, dtype=jnp.float32)
         bias = jnp.zeros(())

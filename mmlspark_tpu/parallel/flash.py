@@ -116,7 +116,13 @@ def _flash_call(q, k, v, *, block_q: int, block_k: int, causal: bool,
 
 
 def flash_available() -> bool:
-    """The compiled kernel needs a real TPU backend; everything else
-    uses interpret mode (tests) or the XLA blockwise fallback."""
+    """True when the compiled kernel should be used: a real TPU backend
+    AND the MMLSPARK_TPU_FLASH=1 opt-in. The kernel has only ever been
+    exercised in interpret mode (the tunnel has been down every round),
+    so until a real-TPU compile + A/B against blockwise_attention is
+    recorded (ROUND4_NOTES.md), production paths default to the known-
+    good XLA fallback rather than first-contact a Mosaic compile."""
     import jax
-    return jax.default_backend() == "tpu"
+
+    from mmlspark_tpu.core.utils import env_flag
+    return jax.default_backend() == "tpu" and env_flag("MMLSPARK_TPU_FLASH")
