@@ -36,7 +36,6 @@ pins both in interpret mode.
 from __future__ import annotations
 
 import functools
-import os
 
 import numpy as np
 

@@ -25,7 +25,7 @@ from mmlspark_tpu.models.vw.learners import (
     _VWBaseLearner,
     _VWBaseModel,
     _batchify,
-    make_sgd_train,
+    jitted_sgd_train,
 )
 from mmlspark_tpu.models.vw.policyeval import BanditEstimator
 
@@ -63,7 +63,6 @@ class VowpalWabbitContextualBandit(_VWBaseLearner):
             raise ValueError("feature indices exceed numBits hash space; "
                              "featurizer and learner numBits must match")
         # one weight bank per action: shift hashed indices by action block
-        from mmlspark_tpu.models.vw.learners import jitted_sgd_train
         run = jitted_sgd_train(num_weights * num_actions, "squared",
                                get("learningRate"), get("powerT"),
                                get("initialT"), get("adaptive"),

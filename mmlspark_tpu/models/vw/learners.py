@@ -18,6 +18,7 @@ jit-compiled minibatched online-SGD scan over hashed features:
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -61,18 +62,24 @@ def _loss_grad(loss: str, pred, y, quantile_tau: float = 0.5):
     raise ValueError(f"unknown loss {loss!r}")
 
 
-_SGD_JIT_CACHE = {}
+_SGD_JIT_CACHE: OrderedDict = OrderedDict()
+_SGD_JIT_CACHE_MAX = 32  # LRU bound: sweeps must not leak executables
 
 
 def jitted_sgd_train(*args, **kwargs):
-    """``jax.jit(make_sgd_train(...))`` memoized by config: repeated
-    fits with the same hyperparameters reuse one traced+compiled
-    update function instead of re-tracing per fit."""
+    """``jax.jit(make_sgd_train(...))`` memoized by config (bounded
+    LRU): repeated fits with the same hyperparameters reuse one
+    traced+compiled update function instead of re-tracing per fit."""
     import jax
     key = (args, tuple(sorted(kwargs.items())))
-    if key not in _SGD_JIT_CACHE:
-        _SGD_JIT_CACHE[key] = jax.jit(make_sgd_train(*args, **kwargs))
-    return _SGD_JIT_CACHE[key]
+    if key in _SGD_JIT_CACHE:
+        _SGD_JIT_CACHE.move_to_end(key)
+        return _SGD_JIT_CACHE[key]
+    fn = jax.jit(make_sgd_train(*args, **kwargs))
+    _SGD_JIT_CACHE[key] = fn
+    while len(_SGD_JIT_CACHE) > _SGD_JIT_CACHE_MAX:
+        _SGD_JIT_CACHE.popitem(last=False)
+    return fn
 
 
 def make_sgd_train(num_weights: int, loss: str, learning_rate: float,
@@ -236,10 +243,10 @@ class _VWBaseLearner(Estimator, _VWParams):
         if int(idx.max(initial=0)) >= num_weights:
             raise ValueError("feature indices exceed numBits hash space; "
                              "featurizer and learner numBits must match")
-        run = make_sgd_train(
-            num_weights, self._loss, get("learningRate"), get("powerT"),
-            get("initialT"), get("adaptive"), get("l1"), get("l2"),
-            quantile_tau=0.5, progressive=progressive)
+        sgd_args = (num_weights, self._loss, get("learningRate"),
+                    get("powerT"), get("initialT"), get("adaptive"),
+                    get("l1"), get("l2"))
+        sgd_kwargs = dict(quantile_tau=0.5, progressive=progressive)
         bidx, bval, by, bwt = _batchify(idx, val, y, wt, get("batchSize"))
         mesh = self._mesh
         if mesh is not None and self.get("interPassSync"):
@@ -252,6 +259,7 @@ class _VWBaseLearner(Estimator, _VWParams):
 
             from mmlspark_tpu.parallel.mesh import DATA_AXIS, axis_size
 
+            run = make_sgd_train(*sgd_args, **sgd_kwargs)
             ndev = axis_size(mesh, DATA_AXIS)
             nb = bidx.shape[0]
             nb_pad = ((nb + ndev - 1) // ndev) * ndev
@@ -279,11 +287,7 @@ class _VWBaseLearner(Estimator, _VWParams):
                           batch_spec, batch_spec),
                 out_specs=(P(), P(), P(), P(), batch_spec)))
         else:
-            run_pass = jitted_sgd_train(
-                num_weights, self._loss, get("learningRate"),
-                get("powerT"), get("initialT"), get("adaptive"),
-                get("l1"), get("l2"), quantile_tau=0.5,
-                progressive=progressive)
+            run_pass = jitted_sgd_train(*sgd_args, **sgd_kwargs)
         w = jnp.zeros(num_weights, dtype=jnp.float32)
         g2 = jnp.zeros(num_weights, dtype=jnp.float32)
         bias = jnp.zeros(())

@@ -266,3 +266,52 @@ def test_decision_type_missing_bits_honored():
     np.testing.assert_allclose(
         np.asarray(b3.predict_jit()(np.array([[np.nan], [0.2]]))),
         [12.0, 11.0])
+
+
+def test_multiclass_import_interleaving():
+    """A hand-written 3-class v4 model string: trees interleave per
+    class (tree t -> class t % K), scoring returns (N, K) where each
+    class's column comes only from its own trees, and the independent
+    walker agrees tree-by-tree."""
+    def tree_block(i, leaf_lo, leaf_hi):
+        return [
+            f"Tree={i}", "num_leaves=2", "num_cat=0",
+            "split_feature=0", "split_gain=1", "threshold=0.5",
+            "decision_type=2",
+            "left_child=-1", "right_child=-2",
+            f"leaf_value={leaf_lo} {leaf_hi}", "leaf_weight=3 3",
+            "leaf_count=3 3",
+            "internal_value=0", "internal_weight=0", "internal_count=6",
+            "is_linear=0", "shrinkage=1", "",
+        ]
+
+    lines = [
+        "tree", "version=v4", "num_class=3", "num_tree_per_iteration=3",
+        "label_index=0", "max_feature_idx=0",
+        "objective=multiclass num_class:3",
+        "feature_names=f0", "feature_infos=none", "",
+    ]
+    # two boosting iterations x 3 classes; class c leaves = c*10 (+1)
+    for it in range(2):
+        for c in range(3):
+            lines += tree_block(it * 3 + c, c * 10 + it,
+                                c * 10 + it + 1)
+    lines += ["end of trees", ""]
+    text = "\n".join(lines)
+
+    b = BoosterArrays.load_model_string(text)
+    assert b.num_class == 3 and b.num_trees == 6
+    x = np.array([[0.2], [0.8]])
+    pred = np.asarray(b.predict_jit()(x))
+    assert pred.shape == (2, 3)
+    # class c at x<=0.5: iter0 leaf (c*10+0) + iter1 leaf (c*10+1)
+    np.testing.assert_allclose(pred[0], [1.0, 21.0, 41.0])
+    np.testing.assert_allclose(pred[1], [3.0, 23.0, 43.0])
+    # independent walker agrees per class
+    trees = _parse_trees(text)
+    for c in range(3):
+        walked = sum(_walk(trees[it * 3 + c], x) for it in range(2))
+        np.testing.assert_allclose(pred[:, c], walked)
+    # per-class SHAP blocks sum to each class margin on import too
+    shap = np.asarray(b.contrib_jit()(x)).reshape(2, 3, 2)
+    np.testing.assert_allclose(shap.sum(axis=2), pred, atol=1e-5)
