@@ -1,0 +1,73 @@
+#!/bin/bash
+# The moment tools/tpu_status appears (tunnel up), run the full
+# measurement list from ROUND4_NOTES.md in priority order, capturing
+# everything under tools/tpu_results/. Safe to re-run; each step is
+# independently timeboxed so one hang can't eat the window.
+set -u
+cd "$(dirname "$0")/.."
+OUT=tools/tpu_results
+mkdir -p "$OUT"
+# gate on the documented trigger: don't burn the measurement window's
+# timeboxes on CPU fallbacks if the tunnel is (still) down
+if ! timeout 120 python -c "from bench import probe_backend; ok, d = probe_backend(); print(d); exit(0 if ok else 75)"; then
+  echo "tunnel down (probe failed); aborting" >&2
+  exit 75
+fi
+stamp() { date -u +%H:%M:%S; }
+run() { # run <name> <timeout-s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  echo "[$(stamp)] $name: $*" | tee -a "$OUT/log.txt"
+  timeout "$tmo" "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"
+  local rc=$?
+  echo "[$(stamp)] $name rc=$rc" | tee -a "$OUT/log.txt"
+  tail -3 "$OUT/$name.out" | tee -a "$OUT/log.txt"
+  if [ "$rc" -ne 0 ]; then
+    echo "--- $name stderr tail ---" | tee -a "$OUT/log.txt"
+    tail -5 "$OUT/$name.err" | tee -a "$OUT/log.txt"
+  fi
+}
+
+# 1. histogram formulation decision (includes the pallas variant)
+run hist 1800 python bench_hist.py
+# 2. flagship throughput as-is
+run bench_default 2400 python bench.py
+# 3. candidate configs: pallas kernel, histogram subtraction
+MMLSPARK_TPU_PALLAS_HIST=1 run bench_pallas 2400 python bench.py
+MMLSPARK_TPU_HIST_SUB=1 run bench_sub 2400 python bench.py
+# 4. profile the best-so-far default for op-level attribution
+BENCH_PROFILE_DIR="$OUT/trace" run bench_profiled 2400 python bench.py
+# 5. the other north stars
+run onnx 1800 python bench_onnx.py 64
+run serving 1200 python tools/bench_serving.py 300
+run text 1800 python tools/bench_text.py 32
+run vw 1200 python tools/bench_vw.py
+# 6. flash kernel: first real compile + A/B (opt-in flag)
+MMLSPARK_TPU_FLASH=1 run flash 900 python - <<'EOF'
+import time
+import jax
+import jax.numpy as jnp
+import numpy as np
+from mmlspark_tpu.parallel.attention import blockwise_attention
+from mmlspark_tpu.parallel.flash import flash_attention
+
+rng = np.random.default_rng(0)
+b, n, h, d = 4, 2048, 8, 64
+q, k, v = (jnp.asarray(rng.normal(size=(b, n, h, d)).astype(np.float32))
+           for _ in range(3))
+for name, fn in (("flash", lambda: flash_attention(q, k, v, causal=True)),
+                 ("blockwise", lambda: blockwise_attention(
+                     q, k, v, causal=True))):
+    out = fn(); jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / 10
+    # causal halves the useful work vs the dense 4*b*h*n^2*d count
+    print(f"{name}: {dt*1e3:.2f} ms "
+          f"({2*b*h*n*n*d/dt/1e12:.1f} causal TFLOP/s)")
+err = float(jnp.abs(flash_attention(q, k, v, causal=True)
+                    - blockwise_attention(q, k, v, causal=True)).max())
+print("max err:", err)
+EOF
+echo "[$(stamp)] ALL DONE — results in $OUT/" | tee -a "$OUT/log.txt"
