@@ -7,6 +7,13 @@ set -u
 cd "$(dirname "$0")/.."
 OUT=tools/tpu_results
 mkdir -p "$OUT"
+# single-instance guard: the poller auto-launches this AND the notes
+# tell operators to run it by hand — never both at once
+exec 9>"$OUT/lock"
+if ! flock -n 9; then
+  echo "another tpu_day.sh is already running; aborting" >&2
+  exit 73
+fi
 # gate on the documented trigger: don't burn the measurement window's
 # timeboxes on CPU fallbacks if the tunnel is (still) down
 if ! timeout 120 python -c "from bench import probe_backend; ok, d = probe_backend(); print(d); exit(0 if ok else 75)"; then
@@ -17,7 +24,7 @@ stamp() { date -u +%H:%M:%S; }
 run() { # run <name> <timeout-s> <cmd...>
   local name=$1 tmo=$2; shift 2
   echo "[$(stamp)] $name: $*" | tee -a "$OUT/log.txt"
-  timeout "$tmo" "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"
+  timeout -k 30 "$tmo" "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"
   local rc=$?
   echo "[$(stamp)] $name rc=$rc" | tee -a "$OUT/log.txt"
   tail -3 "$OUT/$name.out" | tee -a "$OUT/log.txt"

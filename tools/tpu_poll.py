@@ -28,6 +28,25 @@ def main() -> None:
             f.write(f"{stamp} {'UP' if up else 'down'} {detail}\n")
         if up:
             STATUS.write_text(f"TPU_UP {stamp} {detail}\n")
+            # the window may be short and nobody may be watching:
+            # run the measurement runbook immediately. Wait for it and
+            # KEEP POLLING on failure — a tunnel flap between our probe
+            # and the runbook's gate must not end the watch.
+            import subprocess
+            runbook = HERE / "tpu_day.sh"
+            if runbook.exists():
+                with LOG.open("a") as f:
+                    f.write(f"{stamp} launching tpu_day.sh\n")
+                with (HERE / "tpu_day.out").open("a") as out:
+                    rc = subprocess.call(["bash", str(runbook)],
+                                         stdout=out,
+                                         stderr=subprocess.STDOUT)
+                with LOG.open("a") as f:
+                    f.write(f"{stamp} tpu_day.sh rc={rc}\n")
+                if rc != 0:
+                    STATUS.unlink(missing_ok=True)
+                    time.sleep(interval)
+                    continue
             return
         time.sleep(interval)
 
