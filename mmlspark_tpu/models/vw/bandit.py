@@ -117,6 +117,8 @@ class VowpalWabbitContextualBanditModel(_VWBaseModel):
             val = df.col(base).astype(np.float64)
             idx = np.broadcast_to(
                 np.arange(val.shape[1], dtype=np.int64), val.shape).copy()
+        from mmlspark_tpu.models.vw.learners import sanitize_values
+        val = sanitize_values(val)
         nw = self.num_weights_per_action
         costs = np.stack([
             (self.weights[idx + a * nw] * val).sum(axis=1) + self.bias

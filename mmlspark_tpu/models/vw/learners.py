@@ -62,6 +62,15 @@ def _loss_grad(loss: str, pred, y, quantile_tau: float = 0.5):
     raise ValueError(f"unknown loss {loss!r}")
 
 
+
+def sanitize_values(val: np.ndarray) -> np.ndarray:
+    """Non-finite feature values drop to 0 (VW semantics: an absent
+    feature contributes nothing); one inf/NaN would otherwise poison
+    every weight through the SGD update or every margin at scoring."""
+    if not np.isfinite(val).all():
+        return np.where(np.isfinite(val), val, 0.0).astype(val.dtype)
+    return val
+
 _SGD_JIT_CACHE: OrderedDict = OrderedDict()
 _SGD_JIT_CACHE_MAX = 32  # LRU bound: sweeps must not leak executables
 
@@ -219,15 +228,19 @@ class _VWBaseLearner(Estimator, _VWParams):
     def _get_features(self, df: DataFrame) -> Tuple[np.ndarray, np.ndarray]:
         base = self.get("featuresCol")
         if f"{base}_idx" in df:
-            return (df.col(f"{base}_idx").astype(np.int32),
-                    df.col(f"{base}_val").astype(np.float32))
-        # dense vector column fallback: identity indexing
-        x = df.col(base)
-        if x.ndim != 2:
-            raise ValueError(f"featuresCol {base!r}: need <{base}_idx/_val> "
-                             f"hashed columns or a dense vector column")
-        idx = np.broadcast_to(np.arange(x.shape[1], dtype=np.int32), x.shape)
-        return idx.copy(), x.astype(np.float32)
+            idx = df.col(f"{base}_idx").astype(np.int32)
+            val = df.col(f"{base}_val").astype(np.float32)
+        else:
+            # dense vector column fallback: identity indexing
+            x = df.col(base)
+            if x.ndim != 2:
+                raise ValueError(
+                    f"featuresCol {base!r}: need <{base}_idx/_val> "
+                    f"hashed columns or a dense vector column")
+            idx = np.broadcast_to(
+                np.arange(x.shape[1], dtype=np.int32), x.shape).copy()
+            val = x.astype(np.float32)
+        return idx, sanitize_values(val)
 
     def _train_weights(self, df: DataFrame, progressive: bool = False):
         import jax
@@ -392,9 +405,10 @@ class _VWBaseModel(Model, _VWParams):
         base = self.get("featuresCol")
         if f"{base}_idx" in df:
             idx = df.col(f"{base}_idx").astype(np.int64)
-            val = df.col(f"{base}_val").astype(np.float64)
+            val = sanitize_values(df.col(f"{base}_val").astype(np.float64))
             return (self.weights[idx] * val).sum(axis=1) + self.bias
-        x = df.col(base)
+        # dense path stays a BLAS matvec (no O(rows*features) gather)
+        x = sanitize_values(df.col(base).astype(np.float64))
         return x @ self.weights[:x.shape[1]] + self.bias
 
     def get_performance_statistics(self) -> Dict[str, Any]:

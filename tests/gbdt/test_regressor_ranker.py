@@ -224,3 +224,31 @@ def test_start_iteration_prediction_slicing():
     assert m.booster.slice_iterations(4, 3).num_trees == 3
     with pytest.raises(ValueError, match="start_iteration"):
         m.booster.slice_iterations(99)
+
+
+def test_extreme_values_robustness(rng):
+    """±inf and huge magnitudes must survive binning, training,
+    scoring and SHAP without NaNs (the reference inherits this
+    robustness from LightGBM C++; here it must hold through
+    searchsorted binning and f32 device math)."""
+    x = rng.normal(size=(800, 4))
+    x[::50, 0] = np.inf
+    x[1::50, 0] = -np.inf
+    x[2::50, 1] = 1e30
+    x[3::50, 1] = -1e30
+    y = np.where(np.isfinite(x[:, 0]), x[:, 0], 3.0) * 2.0 \
+        + rng.normal(size=800) * 0.1
+    df = DataFrame({"features": x, "label": y})
+    m = LightGBMRegressor(numIterations=8, numLeaves=8, maxBin=32,
+                          featuresShapCol="shap").fit(df)
+    out = m.transform(df)
+    pred = np.asarray(out["prediction"])
+    assert np.isfinite(pred).all()
+    # inf rows all land in the top bin: one consistent prediction group
+    assert np.isfinite(np.asarray(out["shap"])).all()
+    # model string round-trips inf thresholds if any were chosen
+    from mmlspark_tpu.models.gbdt.booster import BoosterArrays
+    reloaded = BoosterArrays.load_model_string(
+        m.booster.save_model_string())
+    np.testing.assert_allclose(
+        np.asarray(reloaded.predict_jit()(x)), pred, atol=1e-5)

@@ -252,3 +252,22 @@ class TestSyncScheduleAndStats:
         for m in (base, shuf):
             pred = m.transform(df)["prediction"]
             assert float(np.corrcoef(pred, y)[0, 1]) > 0.9
+
+
+def test_non_finite_features_do_not_poison_weights(rng):
+    """A single inf/NaN feature value must not NaN every weight via
+    the SGD update: non-finite values drop to 0 (VW semantics: an
+    absent feature contributes nothing)."""
+    from mmlspark_tpu.models.vw.learners import VowpalWabbitRegressor
+
+    x = rng.normal(size=(500, 5))
+    x[::30, 0] = np.inf
+    x[1::30, 1] = np.nan
+    y = np.nan_to_num(x[:, 0], posinf=3.0) + rng.normal(size=500) * 0.1
+    m = VowpalWabbitRegressor(numPasses=2).fit(
+        DataFrame({"features": x, "label": y}))
+    p = np.asarray(m.transform(DataFrame({"features": x}))["prediction"])
+    assert np.isfinite(p).all()
+    # and the model still learned the finite-row signal
+    fin = np.isfinite(x[:, 0]) & np.isfinite(x[:, 1])
+    assert np.corrcoef(p[fin], y[fin])[0, 1] > 0.5

@@ -21,6 +21,7 @@ if ! timeout 120 python -c "from bench import probe_backend; ok, d = probe_backe
   exit 75
 fi
 stamp() { date -u +%H:%M:%S; }
+FAILED=0
 run() { # run <name> <timeout-s> <cmd...>
   local name=$1 tmo=$2; shift 2
   echo "[$(stamp)] $name: $*" | tee -a "$OUT/log.txt"
@@ -29,6 +30,7 @@ run() { # run <name> <timeout-s> <cmd...>
   echo "[$(stamp)] $name rc=$rc" | tee -a "$OUT/log.txt"
   tail -3 "$OUT/$name.out" | tee -a "$OUT/log.txt"
   if [ "$rc" -ne 0 ]; then
+    FAILED=$((FAILED + 1))
     echo "--- $name stderr tail ---" | tee -a "$OUT/log.txt"
     tail -5 "$OUT/$name.err" | tee -a "$OUT/log.txt"
   fi
@@ -77,4 +79,11 @@ err = float(jnp.abs(flash_attention(q, k, v, causal=True)
                     - blockwise_attention(q, k, v, causal=True)).max())
 print("max err:", err)
 EOF
-echo "[$(stamp)] ALL DONE — results in $OUT/" | tee -a "$OUT/log.txt"
+echo "[$(stamp)] DONE ($FAILED step(s) failed) — results in $OUT/" \
+  | tee -a "$OUT/log.txt"
+# nonzero when the window likely flapped away (so the poller resumes
+# watching); a handful of failures with the flagship captured is fine
+if [ "$FAILED" -ge 5 ] || ! grep -q '"value"' "$OUT/bench_default.out" \
+    2>/dev/null; then
+  exit 1
+fi

@@ -41,9 +41,17 @@ def main() -> None:
                     rc = subprocess.call(["bash", str(runbook)],
                                          stdout=out,
                                          stderr=subprocess.STDOUT)
+                done = datetime.datetime.now().isoformat(
+                    timespec="seconds")
                 with LOG.open("a") as f:
-                    f.write(f"{stamp} tpu_day.sh rc={rc}\n")
+                    f.write(f"{done} tpu_day.sh rc={rc}\n")
+                if rc == 73:
+                    # lock held: a manual run is already measuring —
+                    # leave tpu_status in place and end the watch
+                    return
                 if rc != 0:
+                    # gate failure / failed steps: tunnel likely
+                    # flapped — resume polling for the next window
                     STATUS.unlink(missing_ok=True)
                     time.sleep(interval)
                     continue
