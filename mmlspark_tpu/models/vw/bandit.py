@@ -26,6 +26,7 @@ from mmlspark_tpu.models.vw.learners import (
     _VWBaseModel,
     _batchify,
     jitted_sgd_train,
+    sanitize_values,
 )
 from mmlspark_tpu.models.vw.policyeval import BanditEstimator
 
@@ -117,7 +118,6 @@ class VowpalWabbitContextualBanditModel(_VWBaseModel):
             val = df.col(base).astype(np.float64)
             idx = np.broadcast_to(
                 np.arange(val.shape[1], dtype=np.int64), val.shape).copy()
-        from mmlspark_tpu.models.vw.learners import sanitize_values
         val = sanitize_values(val)
         nw = self.num_weights_per_action
         costs = np.stack([

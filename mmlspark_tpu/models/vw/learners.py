@@ -67,9 +67,10 @@ def sanitize_values(val: np.ndarray) -> np.ndarray:
     """Non-finite feature values drop to 0 (VW semantics: an absent
     feature contributes nothing); one inf/NaN would otherwise poison
     every weight through the SGD update or every margin at scoring."""
-    if not np.isfinite(val).all():
-        return np.where(np.isfinite(val), val, 0.0).astype(val.dtype)
-    return val
+    finite = np.isfinite(val)
+    if finite.all():
+        return val
+    return np.where(finite, val, 0.0).astype(val.dtype)
 
 _SGD_JIT_CACHE: OrderedDict = OrderedDict()
 _SGD_JIT_CACHE_MAX = 32  # LRU bound: sweeps must not leak executables

@@ -384,3 +384,29 @@ def test_continuous_latency_with_real_gbdt_model(rng):
         assert lat[len(lat) // 2] < 0.05, f"p50 {lat[15]*1e3:.1f} ms"
     finally:
         server.stop()
+
+
+def test_fleet_soak_with_failover(rng):
+    """Sustained mixed load on a fleet while a worker dies mid-burst:
+    every request must be answered exactly once with the right value
+    (the cluster-serving soak the reference claims; scaled to CI)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from mmlspark_tpu.io.serving import FleetClient, ServingFleet
+
+    with ServingFleet(_DoubleModel(), num_servers=3,
+                      max_latency_ms=2) as fleet:
+        client = FleetClient(fleet.registry_url, timeout=10.0)
+        client.refresh()
+        killed = {"done": False}
+
+        def call(i):
+            if i == 150 and not killed["done"]:
+                killed["done"] = True
+                fleet.servers[0].stop()
+            return i, client.score({"x": float(i)})["doubled"]
+
+        with ThreadPoolExecutor(max_workers=16) as ex:
+            results = dict(ex.map(call, range(400)))
+        assert len(results) == 400
+        assert all(results[i] == 2.0 * i for i in range(400))
