@@ -105,6 +105,63 @@ def create_mesh(config: Optional[MeshConfig] = None,
     return jax.sharding.Mesh(dev_array, names)
 
 
+def distributed_init(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     local_device_ids: Optional[Sequence[int]] = None,
+                     cpu_devices_per_process: Optional[int] = None) -> None:
+    """Join (or bootstrap) a multi-process JAX cluster.
+
+    This is the rendezvous the reference implements by hand twice —
+    the LightGBM driver opens a ServerSocket, collects every executor's
+    ``ip:port``, sorts them into a deterministic ring and mails the
+    roster back (NetworkManager.scala:59-84,322-328); VW builds a
+    spanning tree the same way (VowpalWabbitClusterUtil.scala:15-43).
+    On TPU both planes collapse into ``jax.distributed.initialize``:
+    process 0 runs the coordinator service, every process registers,
+    and afterwards ``jax.devices()`` is the *global* device list in a
+    deterministic order, so ``create_mesh()`` spans hosts with no
+    further ceremony and XLA lays collectives over ICI/DCN.
+
+    All arguments default from the standard env vars
+    (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/
+    ``JAX_PROCESS_ID``) exactly as ``jax.distributed.initialize`` does,
+    so launchers may pass either env or explicit values.
+
+    ``cpu_devices_per_process``: when set, forces that many virtual CPU
+    devices *before* the backend initializes — the offline multi-host
+    test rig (N processes x M virtual CPU devices; collectives ride
+    Gloo). Production TPU processes leave it ``None``.
+    """
+    import jax
+
+    if cpu_devices_per_process is not None:
+        from mmlspark_tpu.core.virtual_devices import force_cpu_devices
+        force_cpu_devices(cpu_devices_per_process)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+
+
+def process_index() -> int:
+    """This process's rank (the reference's main-worker election key,
+    SharedState.scala:55-63: leader == process 0)."""
+    import jax
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()
+
+
+def is_multiprocess() -> bool:
+    import jax
+    return jax.process_count() > 1
+
+
 _DEFAULT_MESH = None
 
 

@@ -1,0 +1,27 @@
+"""Multi-HOST (multi-process) execution tests.
+
+The reference scales past one machine via hand-rolled rendezvous: the
+LightGBM driver's ServerSocket ring (NetworkManager.scala:59-84) and
+VW's spanning tree (VowpalWabbitClusterUtil.scala:15-43). SURVEY §2.9
+maps both onto ``jax.distributed`` init + a process-spanning mesh.
+
+Here that path runs for real: 2 OS processes x 4 virtual CPU devices
+each join through ``distributed_init`` (collectives ride Gloo — the
+offline stand-in for ICI/DCN), train data-parallel GBDT over the global
+8-device mesh, and the result must agree with single-process training
+on the separated-gains fixture (where any mis-reduction flips a split).
+"""
+
+import os
+import sys
+
+HERE = os.path.dirname(__file__)
+
+
+def test_two_process_dp_training_matches_single():
+    sys.path.insert(0, HERE)
+    try:
+        from mp_worker import run_and_check
+    finally:
+        sys.path.pop(0)
+    run_and_check(num_procs=2, devices_per_process=4)
