@@ -139,12 +139,24 @@ def _pallas_level_histogram(binned, grad, hess, live, local, *, width: int,
         out_specs=pl.BlockSpec((1, f, _SPAD, _BIN_PAD),
                                lambda i, bn: (bn[i], 0, 0, 0)),
     )
+    # under shard_map (the voting/feature tree learners) the output
+    # varies over whatever mesh axes the inputs vary over — declare the
+    # union so a check_vma-enabled enclosing shard_map accepts the
+    # per-shard call on the Mosaic (compiled) path; outside shard_map
+    # every vma is empty and this is a no-op. The interpret path
+    # instead runs with the enclosing shard_map's checker off (see
+    # parallel_modes._check_vma): interpret discharges the kernel body
+    # into the manual trace, where kernel-internal constants trip the
+    # checker.
+    vma = frozenset()
+    for operand in (binned, grad, hess, live, local):
+        vma = vma | getattr(jax.typeof(operand), "vma", frozenset())
     kernel = functools.partial(_hist_kernel, num_features=f,
                                bin_pad=_BIN_PAD)
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((width, f, _SPAD, _BIN_PAD),
-                                       jnp.float32),
+                                       jnp.float32, vma=vma),
         grid_spec=grid_spec,
         interpret=interpret,
     )(block_node, bins_pad, data)

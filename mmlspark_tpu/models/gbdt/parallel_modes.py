@@ -70,9 +70,30 @@ def _split_gains(hist, leaf_objective, cfg, b):
     return jnp.where(ok, gain, -jnp.inf), cum
 
 
+def _check_vma() -> bool:
+    """shard_map's static varying-axes checker, on by default. The
+    pallas histogram kernel's INTERPRET-mode discharge creates
+    constants inside the manual trace that the checker refuses to mix
+    with dp-varying refs (a checker limitation, not a correctness
+    issue — jax's own error message recommends this switch), so the
+    builders turn it off exactly when that kernel is opted in AND the
+    backend will interpret it (non-TPU). On TPU the kernel lowers
+    opaquely through Mosaic with its output vma declared, so the
+    checker stays on for the production path."""
+    import jax
+
+    from mmlspark_tpu.models.gbdt.hist_pallas import (
+        pallas_histogram_enabled)
+    return not (pallas_histogram_enabled()
+                and jax.default_backend() != "tpu")
+
+
 def _histogram(binned, grad, hess, live, local, width, f, b):
     # one shared formulation for every tree learner; these builders run
-    # inside shard_map, which constrains the choice (see helper doc)
+    # inside shard_map, which constrains the choice (see helper doc).
+    # With MMLSPARK_TPU_PALLAS_HIST=1 this selects the pallas kernel
+    # per-shard (local rows only; the psum on the returned histogram is
+    # unchanged) — the multi-chip path for the flagship op.
     from mmlspark_tpu.models.gbdt.trainer import _level_histogram
 
     return _level_histogram(binned, grad, hess, live, local, width, f, b,
@@ -201,7 +222,8 @@ def make_build_tree_voting(num_features: int, total_bins: int, cfg,
     return shard_map(
         local_fn, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), row, row, row, P(), P()),
-        out_specs=(P(), P(), P(), P()))
+        out_specs=(P(), P(), P(), P()),
+        check_vma=_check_vma())
 
 
 def make_build_tree_feature_parallel(num_features: int, total_bins: int,
@@ -344,4 +366,5 @@ def make_build_tree_feature_parallel(num_features: int, total_bins: int,
         local_fn, mesh=mesh,
         in_specs=(P(None, FEATURE_AXIS), P(), P(), P(), P(FEATURE_AXIS),
                   P()),
-        out_specs=(P(), P(), P(), P()))
+        out_specs=(P(), P(), P(), P()),
+        check_vma=_check_vma())

@@ -223,9 +223,13 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
         pallas_level_histogram,
     )
 
-    if pallas_histogram_enabled() and not in_shard_map and b <= 256:
+    if pallas_histogram_enabled() and b <= 256:
         # opt-in Pallas kernel (hist_pallas.py; bench_hist.py measures
-        # it against the XLA formulations below on each backend)
+        # it against the XLA formulations below on each backend). Safe
+        # per-shard under shard_map too: the kernel only ever sees this
+        # program's local rows, and the cross-device psum happens on the
+        # returned histogram exactly as for the XLA formulations
+        # (tests/gbdt/test_hist_pallas.py::test_pallas_under_shard_map_modes)
         return pallas_level_histogram(binned, grad, hess, live, local,
                                       width, f, b)
 
