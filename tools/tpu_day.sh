@@ -50,6 +50,8 @@ run onnx 1800 python bench_onnx.py 64
 run serving 1200 python tools/bench_serving.py 300
 run text 1800 python tools/bench_text.py 32
 run vw 1200 python tools/bench_vw.py
+run scoring 1800 python tools/bench_scoring.py
+run ranker 2400 python tools/bench_ranker.py
 # 6. flash kernel: first real compile + A/B (opt-in flag)
 MMLSPARK_TPU_FLASH=1 run flash 900 python - <<'EOF'
 import time
