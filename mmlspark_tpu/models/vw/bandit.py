@@ -67,18 +67,21 @@ class VowpalWabbitContextualBandit(_VWBaseLearner):
         run = jitted_sgd_train(num_weights * num_actions, "squared",
                                get("learningRate"), get("powerT"),
                                get("initialT"), get("adaptive"),
-                               get("l1"), get("l2"))
+                               get("l1"), get("l2"),
+                               normalized=get("normalized"))
         shifted = (idx.astype(np.int64)
                    + (action[:, None] * num_weights)).astype(np.int64)
         bidx, bval, by, bwt = _batchify(shifted, val, cost, wt, get("batchSize"))
         w = jnp.zeros(num_weights * num_actions, dtype=jnp.float32)
         g2 = jnp.zeros_like(w)
+        s = jnp.zeros_like(w)
+        n_acc = jnp.zeros(())
         bias = jnp.zeros(())
         t = jnp.zeros(())
         for _ in range(get("numPasses")):
-            w, g2, bias, t, _ = run(w, g2, bias, t, jnp.asarray(bidx),
-                                    jnp.asarray(bval), jnp.asarray(by),
-                                    jnp.asarray(bwt))
+            w, g2, s, n_acc, bias, t, _ = run(
+                w, g2, s, n_acc, bias, t, jnp.asarray(bidx),
+                jnp.asarray(bval), jnp.asarray(by), jnp.asarray(bwt))
         model = VowpalWabbitContextualBanditModel(
             **{k: v for k, v in self._paramMap.items()
                if VowpalWabbitContextualBanditModel.has_param(k)})

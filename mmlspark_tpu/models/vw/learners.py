@@ -94,40 +94,95 @@ def jitted_sgd_train(*args, **kwargs):
 
 def make_sgd_train(num_weights: int, loss: str, learning_rate: float,
                    power_t: float, initial_t: float, adaptive: bool,
-                   l1: float, l2: float, quantile_tau: float = 0.5,
-                   progressive: bool = False):
-    """Build jittable (w, g2, bias, t0, idx, val, y, wt) -> updated state
-    scanning over leading batch dim. Shapes: idx/val (B, W), y/wt (B,)."""
+                   l1: float, l2: float, normalized: bool = False,
+                   quantile_tau: float = 0.5, progressive: bool = False):
+    """Build jittable (w, g2, scale, n_acc, bias, t0, idx, val, y, wt)
+    -> updated state scanning over leading batch dim. Shapes: idx/val
+    (B, W), y/wt (B,).
+
+    ``normalized`` adds VW's ``--normalized`` per-feature scale
+    accumulators (the third member of native VW's default
+    adaptive+normalized+invariant update trio,
+    VowpalWabbitBaseLearner.scala driving vw gd.cc; the NAG algorithm
+    of Ross/Mineiro/Langford 2013): ``scale_i`` tracks max |x_i| seen,
+    weights are squashed when a feature's scale grows, per-feature
+    learning rates divide by the scale, and a global ``(t/N)^power_t``
+    factor (N = accumulated normalized squared norms) restores the
+    effective rate. Net effect: predictions are invariant to
+    per-feature rescaling of the input — pinned by
+    tests/vw/test_vw.py::test_normalized_scale_invariance.
+    """
     import jax
     import jax.numpy as jnp
 
     def step(carry, batch):
-        w, g2, bias, t = carry
+        w, g2, s, n_acc, bias, t = carry
         idx, val, y, wt = batch
+        batch_n = jnp.maximum(jnp.sum((wt > 0)), 1)
+        if normalized:
+            # observe new per-feature scales (pad rows excluded); when
+            # a scale grows, squash the weight trained at the old scale
+            # (one power of the ratio with adaptive — its sqrt(G) term
+            # carries the other — else two, per the NAG paper)
+            av = (jnp.abs(val) * (wt[:, None] > 0)).reshape(-1)
+            # one scatter-max straight onto s (av >= 0 and s >= 0, so
+            # this equals max(s, per-feature batch max) without a
+            # num_weights-sized temporary in the scanned hot loop)
+            s_new = s.at[idx.reshape(-1)].max(av)
+            ratio = jnp.where(s_new > 0,
+                              jnp.where(s > 0,
+                                        s / jnp.maximum(s_new, 1e-30),
+                                        1.0),
+                              1.0)
+            w = w * (ratio if adaptive else ratio * ratio)
+            s = s_new
+            sj = s[idx]
+            xn2 = jnp.where(sj > 0,
+                            (val / jnp.maximum(sj, 1e-30)) ** 2, 0.0)
+            n_acc = n_acc + jnp.sum(
+                jnp.sum(xn2, axis=-1) * (wt > 0)) / batch_n
         pred = jnp.sum(w[idx] * val, axis=-1) + bias
         dldp = _loss_grad(loss, pred, y, quantile_tau) * wt
-        batch_n = jnp.maximum(jnp.sum((wt > 0)), 1)
         gw = jnp.zeros_like(w).at[idx.reshape(-1)].add(
             (dldp[:, None] * val).reshape(-1) / batch_n)
         gb = jnp.sum(dldp) / batch_n
         if l2:
             gw = gw + l2 * w
         lr_t = learning_rate * (initial_t / (initial_t + t)) ** power_t
+        if normalized:
+            # bias behaves as a constant feature with scale 1, so the
+            # global factor applies to it too
+            nf = (jnp.maximum(t + 1.0, 1.0)
+                  / jnp.maximum(n_acc, 1e-8)) ** power_t
+            lr_t = lr_t * nf
         if adaptive:
-            g2 = g2 + gw * gw
-            w = w - lr_t * gw / jnp.sqrt(g2 + 1e-8)
+            if normalized:
+                # accumulate AdaGrad state in NORMALIZED gradient units
+                # (g/s is invariant to per-feature rescaling), so the
+                # 1e-8 epsilon compares against a scale-free quantity —
+                # accumulating raw g^2 ~ c^2 would let the epsilon
+                # distort small-scale features and break invariance
+                sg = jnp.where(s > 0, s, 1.0)
+                gn = gw / sg
+                g2 = g2 + gn * gn
+                w = w - lr_t * (gn / sg) / jnp.sqrt(g2 + 1e-8)
+            else:
+                g2 = g2 + gw * gw
+                w = w - lr_t * gw / jnp.sqrt(g2 + 1e-8)
         else:
+            if normalized:
+                gw = gw / jnp.where(s > 0, s * s, 1.0)
             w = w - lr_t * gw
         if l1:
             w = jnp.sign(w) * jnp.maximum(jnp.abs(w) - lr_t * l1, 0.0)
         bias = bias - lr_t * gb
         out = pred if progressive else jnp.zeros(())
-        return (w, g2, bias, t + 1.0), out
+        return (w, g2, s, n_acc, bias, t + 1.0), out
 
-    def run(w, g2, bias, t0, idx, val, y, wt):
-        (w, g2, bias, t), preds = jax.lax.scan(
-            step, (w, g2, bias, t0), (idx, val, y, wt))
-        return w, g2, bias, t, preds
+    def run(w, g2, s, n_acc, bias, t0, idx, val, y, wt):
+        (w, g2, s, n_acc, bias, t), preds = jax.lax.scan(
+            step, (w, g2, s, n_acc, bias, t0), (idx, val, y, wt))
+        return w, g2, s, n_acc, bias, t, preds
 
     return run
 
@@ -166,6 +221,11 @@ class _VWParams(HasLabelCol, HasWeightCol, HasPredictionCol):
                      default=1.0)
     adaptive = Param("adaptive", "AdaGrad per-weight rates (--adaptive)",
                      to_bool, default=False)
+    normalized = Param(
+        "normalized", "per-feature scale-invariant updates "
+        "(--normalized; with adaptive, two thirds of native VW's "
+        "default adaptive+normalized+invariant trio — invariant-style "
+        "power_t decay is always on here)", to_bool, default=False)
     l1 = Param("l1", "L1 regularization", to_float, ge(0), default=0.0)
     l2 = Param("l2", "L2 regularization", to_float, ge(0), default=0.0)
     batchSize = Param("batchSize", "rows per online update (1 = exact "
@@ -200,6 +260,8 @@ class _VWParams(HasLabelCol, HasWeightCol, HasPredictionCol):
                 return args[i]
             if a in ("--adaptive",):
                 out["adaptive"] = True
+            elif a == "--normalized":
+                out["normalized"] = True
             elif a in ("-l", "--learning_rate"):
                 out["learningRate"] = float(take())
             elif a == "--power_t":
@@ -260,7 +322,8 @@ class _VWBaseLearner(Estimator, _VWParams):
         sgd_args = (num_weights, self._loss, get("learningRate"),
                     get("powerT"), get("initialT"), get("adaptive"),
                     get("l1"), get("l2"))
-        sgd_kwargs = dict(quantile_tau=0.5, progressive=progressive)
+        sgd_kwargs = dict(normalized=get("normalized"), quantile_tau=0.5,
+                          progressive=progressive)
         bidx, bval, by, bwt = _batchify(idx, val, y, wt, get("batchSize"))
         mesh = self._mesh
         if mesh is not None and self.get("interPassSync"):
@@ -283,27 +346,35 @@ class _VWBaseLearner(Estimator, _VWParams):
                         [a, np.zeros((nb_pad - nb,) + a.shape[1:], a.dtype)])
                 bidx, bval, by, bwt = map(padb, (bidx, bval, by, bwt))
 
-            def sharded_pass(w, g2, bias, t, bi, bv, byy, bw):
+            def sharded_pass(w, g2, s, n_acc, bias, t, bi, bv, byy, bw):
                 # mark the replicated carry as device-varying so the scan
                 # carry type stays consistent once batch data flows in
-                w, g2, bias, t = jax.lax.pcast((w, g2, bias, t), DATA_AXIS, to='varying')
-                w, g2, bias, t, preds = run(w, g2, bias, t, bi, bv, byy, bw)
+                w, g2, s, n_acc, bias, t = jax.lax.pcast(
+                    (w, g2, s, n_acc, bias, t), DATA_AXIS, to='varying')
+                w, g2, s, n_acc, bias, t, preds = run(
+                    w, g2, s, n_acc, bias, t, bi, bv, byy, bw)
                 w = jax.lax.pmean(w, DATA_AXIS)
                 g2 = jax.lax.pmean(g2, DATA_AXIS)
+                # scales are maxima, not means: pmax keeps the squash
+                # bound valid on every shard after the sync
+                s = jax.lax.pmax(s, DATA_AXIS)
+                n_acc = jax.lax.pmean(n_acc, DATA_AXIS)
                 bias = jax.lax.pmean(bias, DATA_AXIS)
                 t = jax.lax.pmean(t, DATA_AXIS)
-                return w, g2, bias, t, preds
+                return w, g2, s, n_acc, bias, t, preds
 
             batch_spec = P(DATA_AXIS)
             run_pass = jax.jit(shard_map(
                 sharded_pass, mesh=mesh,
-                in_specs=(P(), P(), P(), P(), batch_spec, batch_spec,
-                          batch_spec, batch_spec),
-                out_specs=(P(), P(), P(), P(), batch_spec)))
+                in_specs=(P(), P(), P(), P(), P(), P(), batch_spec,
+                          batch_spec, batch_spec, batch_spec),
+                out_specs=(P(), P(), P(), P(), P(), P(), batch_spec)))
         else:
             run_pass = jitted_sgd_train(*sgd_args, **sgd_kwargs)
         w = jnp.zeros(num_weights, dtype=jnp.float32)
         g2 = jnp.zeros(num_weights, dtype=jnp.float32)
+        s = jnp.zeros(num_weights, dtype=jnp.float32)
+        n_acc = jnp.zeros(())
         bias = jnp.zeros(())
         t = jnp.ones(()) * 0.0
         all_preds = []
@@ -331,13 +402,13 @@ class _VWBaseLearner(Estimator, _VWParams):
                     bidx, bval = bidx[order], bval[order]
                     by, bwt = by[order], bwt[order]
                 preds_parts = []
-                for s in range(0, nb_total, seg):
-                    w, g2, bias, t, preds = run_pass(
-                        w, g2, bias, t,
-                        jnp.asarray(bidx[s:s + seg]),
-                        jnp.asarray(bval[s:s + seg]),
-                        jnp.asarray(by[s:s + seg]),
-                        jnp.asarray(bwt[s:s + seg]))
+                for b0 in range(0, nb_total, seg):
+                    w, g2, s, n_acc, bias, t, preds = run_pass(
+                        w, g2, s, n_acc, bias, t,
+                        jnp.asarray(bidx[b0:b0 + seg]),
+                        jnp.asarray(bval[b0:b0 + seg]),
+                        jnp.asarray(by[b0:b0 + seg]),
+                        jnp.asarray(bwt[b0:b0 + seg]))
                     if progressive and p == 0:
                         preds_parts.append(np.asarray(preds).reshape(-1))
                 if progressive and p == 0:
@@ -347,6 +418,7 @@ class _VWBaseLearner(Estimator, _VWParams):
         state = {
             "weights": np.asarray(w),
             "g2": np.asarray(g2),
+            "scale": np.asarray(s),
             "bias": float(bias),
             "loss": self._loss,
             "stats": {
