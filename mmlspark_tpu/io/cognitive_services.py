@@ -398,13 +398,26 @@ class AddDocuments(CognitiveServiceTransformer):
                 headers=headers)
             with self._open_retrying(req) as r:
                 reply = _json.loads(r.read())
-            for j, st in enumerate(reply.get("value", [])):
-                if start + j < len(statuses):
+            replies = reply.get("value", [])
+            # a short reply or an entry with no explicit status is a
+            # FAILURE, not a silent success: the service contract is one
+            # status per submitted document (ADVICE r4)
+            for j in range(len(batch)):
+                if j < len(replies):
+                    st = replies[j]
+                    ok = bool(st.get("status", False))
                     statuses[start + j] = st
-                    if not st.get("status", True):
-                        errors[start + j] = st.get("errorMessage",
-                                                   "upload failed")
-                if self.get("fatalErrors") and not st.get("status", True):
+                    if not ok:
+                        errors[start + j] = st.get(
+                            "errorMessage",
+                            "upload failed (no status in reply)")
+                else:
+                    st = {"status": False,
+                          "errorMessage": "no reply entry for document"}
+                    ok = False
+                    statuses[start + j] = st
+                    errors[start + j] = st["errorMessage"]
+                if self.get("fatalErrors") and not ok:
                     raise RuntimeError(
                         f"index upload failed for key "
                         f"{st.get('key')!r}: {st.get('errorMessage')}")
@@ -514,6 +527,43 @@ class SpeechToTextSDK(SpeechToText):
         "streamIntermediateResults", "emit one row element per segment "
         "instead of the joined transcript", to_bool, default=True)
 
+    @staticmethod
+    def _riff_data_payload(audio: bytes) -> bytes:
+        """Walk a RIFF/WAVE chunk list to the ``data`` chunk's payload.
+        Returns the input unchanged if the container is malformed (the
+        service will reject it with a clearer error than we could
+        synthesize)."""
+        import struct
+
+        if len(audio) < 12 or audio[8:12] != b"WAVE":
+            return audio
+        off = 12
+        while off + 8 <= len(audio):
+            cid = audio[off:off + 4]
+            (size,) = struct.unpack("<I", audio[off + 4:off + 8])
+            if cid == b"data":
+                return audio[off + 8:off + 8 + size]
+            # chunks are word-aligned: odd sizes carry a pad byte
+            off += 8 + size + (size & 1)
+        return audio
+
+    @staticmethod
+    def _wav_header(data_len: int, sample_rate: int, bps: int,
+                    fmt: int) -> bytes:
+        """Minimal RIFF/WAVE header so every chunk is a well-formed
+        one-shot request (the real short-audio REST endpoint rejects
+        headerless PCM slices — ADVICE r4). ``fmt``: 1 = integer PCM,
+        3 = IEEE float (the ndarray float32 path)."""
+        import struct
+
+        byte_rate = sample_rate * bps
+        return (b"RIFF"
+                + struct.pack("<I", 36 + data_len)
+                + b"WAVEfmt "
+                + struct.pack("<IHHIIHH", 16, fmt, 1, sample_rate,
+                              byte_rate, bps, bps * 8)
+                + b"data" + struct.pack("<I", data_len))
+
     def _transform(self, dataset):
         import json as _json
         import urllib.request
@@ -526,18 +576,27 @@ class SpeechToTextSDK(SpeechToText):
             v = row[self.get("audioDataCol")]
             # ndarray audio serializes as float32 (4 bytes/sample)
             # regardless of the PCM param, which describes raw bytes
-            bps = 4 if isinstance(v, np.ndarray) \
-                else self.get("bytesPerSample")
+            is_float = isinstance(v, np.ndarray)
+            bps = 4 if is_float else self.get("bytesPerSample")
             audio = self._audio_bytes(row)
+            # bytes that already carry a RIFF container: walk the chunk
+            # list to the 'data' payload (headers are not fixed-size —
+            # an 18-byte fmt or LIST/fact chunks are common) and strip
+            # it; every streamed chunk gets its own synthesized header
+            if not is_float and audio[:4] == b"RIFF":
+                audio = self._riff_data_payload(audio)
             chunk_bytes = max(1, (self.get("sampleRate") * bps
                                   * self.get("chunkMs")) // 1000)
             # never tear a sample across chunks
             chunk_bytes = max(bps, (chunk_bytes // bps) * bps)
             segments = []
             for off in range(0, len(audio), chunk_bytes):
-                req = urllib.request.Request(
-                    url, data=audio[off:off + chunk_bytes],
-                    headers=headers)
+                chunk = audio[off:off + chunk_bytes]
+                body = self._wav_header(
+                    len(chunk), self.get("sampleRate"), bps,
+                    3 if is_float else 1) + chunk
+                req = urllib.request.Request(url, data=body,
+                                             headers=headers)
                 with self._open_retrying(req) as r:
                     seg = self._parse(_json.loads(r.read()))
                 if seg:

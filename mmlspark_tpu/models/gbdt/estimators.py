@@ -73,6 +73,8 @@ def _apply_pass_through(cfg: TrainConfig, args: Optional[str]) -> TrainConfig:
             raise ValueError(
                 f"passThroughArgs: {key!r} is not a training option "
                 "this engine knows (see PARAMS.md for the parity table)")
+        # single-valued sequence fields ('label_gain=1') are wrapped to
+        # 1-tuples by TrainConfig.__post_init__ (runs via replace below)
         updates[key] = _parse_arg_value(val)
     return replace(cfg, **updates)
 

@@ -149,16 +149,30 @@ class TrainConfig:
 
     def __post_init__(self):
         # eval_at may arrive as a list; the config is used as a cache key
-        # for compiled functions, so every field must be hashable
+        # for compiled functions, so every field must be hashable.
+        # Sequence fields also accept a bare scalar ('label_gain=1' via
+        # passThroughArgs, or direct construction — ADVICE r4): wrap it
+        # in a 1-tuple here so tuple(cfg.label_gain) consumers never see
+        # an opaque TypeError. eval_at stays scalar-or-tuple (a scalar
+        # is a documented value for it).
         if isinstance(self.eval_at, list):
             object.__setattr__(self, "eval_at", tuple(self.eval_at))
-        if isinstance(self.label_gain, (list, np.ndarray)):
+        if isinstance(self.label_gain, (int, float)):
+            object.__setattr__(self, "label_gain",
+                               (float(self.label_gain),))
+        elif isinstance(self.label_gain, (list, np.ndarray)):
             object.__setattr__(self, "label_gain",
                                tuple(float(g) for g in self.label_gain))
-        if isinstance(self.categorical_features, (list, np.ndarray)):
+        if isinstance(self.categorical_features, (int, np.integer)):
+            object.__setattr__(self, "categorical_features",
+                               (int(self.categorical_features),))
+        elif isinstance(self.categorical_features, (list, np.ndarray)):
             object.__setattr__(self, "categorical_features",
                                tuple(int(i) for i in self.categorical_features))
-        if isinstance(self.monotone_constraints, (list, np.ndarray)):
+        if isinstance(self.monotone_constraints, (int, np.integer)):
+            object.__setattr__(self, "monotone_constraints",
+                               (int(self.monotone_constraints),))
+        elif isinstance(self.monotone_constraints, (list, np.ndarray)):
             object.__setattr__(self, "monotone_constraints",
                                tuple(int(i) for i in self.monotone_constraints))
 
@@ -374,7 +388,16 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
 
             # --- histogram --------------------------------------------
             if subtract and d > 0:
-                # smaller child only; sibling by subtraction
+                # smaller child only; sibling by subtraction.
+                # INVARIANT (ADVICE r4): ``live`` must stay BINARY.
+                # prev_ss picks the smaller child by the cover stat
+                # (left_stats[:,2] = sum of live), which bounds its ROW
+                # count by n//2+1 only because every live row weighs
+                # exactly 1 (GOSS folds amplification into grad/hess,
+                # bagging masks are 0/1). A fractional row mask would
+                # let the weighted-smaller side hold more than n_half
+                # rows and the sized nonzero below would silently drop
+                # rows, corrupting histograms.
                 par_row = local // 2
                 side = (local % 2).astype(jnp.int32)
                 sel = (live > 0) & (side == prev_ss[par_row])

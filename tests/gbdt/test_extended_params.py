@@ -310,6 +310,17 @@ def test_pass_through_binning_and_none_default_keys(reg_df):
     m3 = LightGBMRegressor(passThroughArgs="label_gain=0,1.5,3",
                            **kw).fit(df)
     assert m3.booster.num_trees == 3
+    # single-valued sequence fields coerce to 1-tuples instead of bare
+    # scalars that explode in tuple(cfg.label_gain) later (ADVICE r4)
+    from mmlspark_tpu.models.gbdt.estimators import _apply_pass_through
+    from mmlspark_tpu.models.gbdt.trainer import TrainConfig
+    cfg = _apply_pass_through(
+        TrainConfig(), "label_gain=1 categorical_features=3 "
+        "monotone_constraints=-1")
+    assert cfg.label_gain == (1,)
+    assert cfg.categorical_features == (3,)
+    assert cfg.monotone_constraints == (-1,)
+    assert tuple(cfg.label_gain) == (1,)  # the r4 failure mode
 
 
 def test_max_position_truncates_gradients(rng):
