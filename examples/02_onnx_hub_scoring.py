@@ -1,0 +1,51 @@
+"""ONNX hub checkout + batch scoring with the committed checkpoints.
+
+Reference workflow: ONNXHub.getModel -> ONNXModel.setDeepVisionFeatures
+(onnx/ONNXModel.scala). The repo ships two genuinely trained tiny
+checkpoints (tools/train_tiny_encoders.py); this example embeds
+sentences with the text encoder and shows that same-topic sentences are
+nearest neighbors.
+"""
+import _common
+
+_common.setup()
+
+import os
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.dl.embedder import SentenceEmbedder
+from mmlspark_tpu.onnx.model import ONNXHub
+
+HUB_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "mmlspark_tpu", "resources", "hub")
+
+
+def main() -> None:
+    hub = ONNXHub(HUB_DIR)
+    print("hub models:", [e["model"] for e in hub.list_models()])
+
+    texts = [
+        "the dog chased a cat near the otter",          # animals
+        "a hawk and an eagle watched the rabbit",       # animals
+        "the stock dividend raised the portfolio yield",  # finance
+        "broker issued an invoice with credit and margin",  # finance
+    ]
+    df = DataFrame({"text": np.array(texts, dtype=object)})
+    emb = SentenceEmbedder(
+        inputCol="text", outputCol="emb",
+        modelFile=os.path.join(HUB_DIR, "tiny-text-encoder.onnx"),
+        maxLength=16, vocabSize=2048)
+    z = np.asarray(emb.transform(df)["emb"], np.float64)
+    z = z / np.linalg.norm(z, axis=1, keepdims=True)
+    sims = z @ z.T
+    print(f"same-topic cosine:  {sims[0, 1]:.3f} (animals), "
+          f"{sims[2, 3]:.3f} (finance)")
+    print(f"cross-topic cosine: {sims[0, 2]:.3f}")
+    assert sims[0, 1] > sims[0, 2] and sims[2, 3] > sims[0, 2]
+    print("OK 02_onnx_hub_scoring")
+
+
+if __name__ == "__main__":
+    main()

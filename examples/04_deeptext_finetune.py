@@ -1,0 +1,61 @@
+"""Fine-tune a text classifier from the committed trained encoder.
+
+Reference workflow: DeepTextClassifier starting from a downloaded
+checkpoint (deep-learning/.../DeepTextClassifier.py). Zero egress here,
+so the backbone is the repo's own trained tiny text encoder
+(tools/train_tiny_encoders.py, committed under resources/hub) and the
+task is topic classification over fresh sentences.
+"""
+import _common
+
+_common.setup()
+
+import os
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.dl.text import DeepTextClassifier
+from tools.train_tiny_encoders import FILLER, TOPICS
+
+HUB_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "mmlspark_tpu", "resources", "hub")
+
+
+def make_sentences(rng, names, per_topic, with_filler=True):
+    texts, labels = [], []
+    for li, t in enumerate(names):
+        for _ in range(per_topic):
+            ws = list(rng.choice(TOPICS[t], size=6))
+            if with_filler:
+                ws += list(rng.choice(FILLER, size=2))
+            rng.shuffle(ws)
+            texts.append(" ".join(ws))
+            labels.append(float(li))
+    return np.array(texts, dtype=object), np.array(labels)
+
+
+def main() -> None:
+    rng = np.random.default_rng(8)
+    names = sorted(TOPICS)[:3]
+    texts, labels = make_sentences(rng, names, per_topic=60)
+    df = DataFrame({"text": texts, "label": labels})
+
+    clf = DeepTextClassifier(
+        backboneFile=os.path.join(HUB_DIR, "tiny-text-encoder.onnx"),
+        textCol="text", labelCol="label", maxLength=16, vocabSize=2048,
+        batchSize=32, maxEpochs=6, learningRate=5e-3).fit(df)
+
+    held_x, held_y = make_sentences(rng, names, per_topic=20,
+                                    with_filler=False)
+    pred = np.asarray(clf.transform(
+        DataFrame({"text": held_x}))["prediction"])
+    acc = float((pred == held_y).mean())
+    print(f"held-out topic accuracy: {acc:.3f} "
+          f"({len(names)} classes, {len(held_x)} sentences)")
+    assert acc > 0.85
+    print("OK 04_deeptext_finetune")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""Shared example preamble: honor MMLSPARK_TPU_PLATFORM before jax use.
+
+Env-var platform overrides (JAX_PLATFORMS) are read when jax registers
+backends — too late in images whose sitecustomize pre-imports a TPU
+plugin — so the override must go through jax.config first.
+"""
+
+import os
+import sys
+
+
+def setup() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    plat = os.environ.get("MMLSPARK_TPU_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
