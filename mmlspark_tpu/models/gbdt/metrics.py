@@ -102,11 +102,21 @@ def quantile_loss(raw, labels, weights=None, alpha: float = 0.5):
 
 def ndcg_at(k: int, label_gain=None):
     def ndcg(raw, labels, weights=None, group_ids=None):
-        from mmlspark_tpu.models.gbdt.objectives import group_ranks
+        from mmlspark_tpu.models.gbdt.objectives import (
+            dense_group_index,
+            group_ranks,
+        )
 
         if group_ids is None:
             raise ValueError("ndcg requires group_ids")
-        same = group_ids[:, None] == group_ids[None, :]
+        # per-group aggregation via segment sums over dense group
+        # indices — O(N log N), no (N, N) pair mask (which made the
+        # metric quadratic in TOTAL rows, not group size)
+        import jax
+
+        n = raw.shape[0]
+        dense = dense_group_index(group_ids)
+        seg = lambda v: jax.ops.segment_sum(v, dense, num_segments=n)  # noqa: E731
         pred_rank = group_ranks(raw, group_ids)
         ideal_rank = group_ranks(labels, group_ids)
         if label_gain is not None:
@@ -117,15 +127,14 @@ def ndcg_at(k: int, label_gain=None):
             gain = 2.0 ** labels - 1.0
         dcg_t = jnp.where(pred_rank < k, gain / jnp.log2(2.0 + pred_rank), 0.0)
         idcg_t = jnp.where(ideal_rank < k, gain / jnp.log2(2.0 + ideal_rank), 0.0)
-        samef = same.astype(raw.dtype)
-        dcg_g = samef @ dcg_t
-        idcg_g = jnp.maximum(samef @ idcg_t, 1e-12)
+        dcg_g = seg(dcg_t)[dense]
+        idcg_g = jnp.maximum(seg(idcg_t)[dense], 1e-12)
         # every row carries its group's NDCG; weight rows by 1/group_size
         # so each group counts once in the mean. Groups whose rows all
         # have zero weight (e.g. mesh-padding groups) are excluded.
         w = _w(weights, raw)
-        group_valid = (samef @ (w > 0).astype(raw.dtype)) > 0
-        gsize = jnp.sum(samef, axis=1)
+        group_valid = seg((w > 0).astype(raw.dtype))[dense] > 0
+        gsize = seg(jnp.ones_like(raw))[dense]
         per_row_ndcg = dcg_g / idcg_g
         inc = jnp.where(group_valid, 1.0 / gsize, 0.0)
         num_groups = jnp.maximum(jnp.sum(inc), 1e-12)

@@ -130,21 +130,121 @@ def group_ranks(scores: Array, group_ids: Array) -> Array:
         (pos - start_pos).astype(jnp.int32))
 
 
+def dense_group_index(group_ids: Array) -> Array:
+    """Arbitrary (traced) group ids -> dense indices in [0, G),
+    numbered in sorted-group-id order (NOT first-occurrence order).
+    O(N log N), no (N, N) intermediates; ``num_segments=N`` (static)
+    upper-bounds G for segment ops."""
+    n = group_ids.shape[0]
+    order = jnp.argsort(group_ids, stable=True)
+    sg = group_ids[order]
+    is_start = jnp.concatenate(
+        [jnp.ones(1, dtype=jnp.int32),
+         (sg[1:] != sg[:-1]).astype(jnp.int32)])
+    dense_sorted = jnp.cumsum(is_start) - 1
+    return jnp.zeros(n, dtype=jnp.int32).at[order].set(
+        dense_sorted.astype(jnp.int32))
+
+
+def make_group_layout(group_ids) -> tuple:
+    """HOST-side (numpy) padded group layout for the bucketed
+    lambdarank: returns ``(rows, mask)`` where ``rows`` is (G, S) int32
+    indices into the row arrays (pad slots point at index N — callers
+    append one sentinel row) and ``mask`` is (G, S) float32 1.0 on real
+    slots. G = number of groups, S = max group size: both static, so
+    the (G, S, S) pairwise work compiles to fixed shapes regardless of
+    how rows are distributed over queries."""
+    import numpy as np
+
+    gid = np.asarray(group_ids)
+    n = gid.shape[0]
+    inv = np.unique(gid, return_inverse=True)[1]
+    order = np.argsort(inv, kind="stable")
+    counts = np.bincount(inv)
+    g, s = len(counts), int(counts.max())
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pos_within = np.arange(n) - starts[inv[order]]
+    rows = np.full((g, s), n, dtype=np.int32)
+    mask = np.zeros((g, s), dtype=np.float32)
+    rows[inv[order], pos_within] = order.astype(np.int32)
+    mask[inv[order], pos_within] = 1.0
+    return rows, mask
+
+
+def _ranks_within(x: Array, mask: Array) -> Array:
+    """(G, S) scores -> 0-based descending rank within each group row;
+    masked slots sort last; ties break by slot (= original row) order."""
+    neg = jnp.where(mask > 0, -x, jnp.inf)
+    order = jnp.argsort(neg, axis=1, stable=True)      # (G, S)
+    return jnp.argsort(order, axis=1).astype(jnp.int32)
+
+
+def _lambdarank_bucketed(preds, labels, group_layout, sigmoid_p,
+                         truncation_level, label_gain):
+    """(G, S, S) within-group pairwise lambdas — compute and memory
+    scale with G*S^2 (rows x max-group-size), never with N^2."""
+    rows, mask = group_layout
+    pp = jnp.concatenate([preds, jnp.zeros(1, preds.dtype)])[rows]
+    ll = jnp.concatenate([labels, jnp.zeros(1, labels.dtype)])[rows]
+    if label_gain is not None:
+        lg = jnp.asarray(label_gain, preds.dtype)
+        gain = lg[jnp.clip(ll.astype(jnp.int32), 0, lg.shape[0] - 1)]
+    else:
+        gain = 2.0 ** ll - 1.0
+    gain = gain * mask
+    pred_rank = _ranks_within(pp, mask)
+    ideal_rank = _ranks_within(ll, mask)
+    disc_pred = 1.0 / jnp.log2(2.0 + pred_rank)
+    disc_ideal = 1.0 / jnp.log2(2.0 + ideal_rank)
+    idcg = jnp.maximum(jnp.sum(gain * disc_ideal * mask, axis=1), 1e-12)
+
+    s_diff = pp[:, :, None] - pp[:, None, :]
+    label_diff = ll[:, :, None] - ll[:, None, :]
+    valid = ((mask[:, :, None] * mask[:, None, :]) > 0) \
+        & (label_diff > 0)
+    topk = pred_rank < truncation_level
+    valid = valid & (topk[:, :, None] | topk[:, None, :])
+    rho = jax.nn.sigmoid(-sigmoid_p * s_diff)
+    delta_ndcg = jnp.abs(
+        (gain[:, :, None] - gain[:, None, :]) *
+        (disc_pred[:, :, None] - disc_pred[:, None, :])
+    ) / idcg[:, None, None]
+    lam = jnp.where(valid, -sigmoid_p * rho * delta_ndcg, 0.0)
+    h = jnp.where(valid,
+                  sigmoid_p * sigmoid_p * rho * (1 - rho) * delta_ndcg,
+                  0.0)
+    grad_gs = (jnp.sum(lam, axis=2) - jnp.sum(lam, axis=1)) * mask
+    hess_gs = (jnp.sum(h, axis=2) + jnp.sum(h, axis=1)) * mask
+    n = preds.shape[0]
+    flat_rows = rows.reshape(-1)
+    grad = jnp.zeros(n + 1, preds.dtype).at[flat_rows].add(
+        grad_gs.reshape(-1))[:n]
+    hess = jnp.zeros(n + 1, preds.dtype).at[flat_rows].add(
+        hess_gs.reshape(-1))[:n]
+    return grad, jnp.maximum(hess, 1e-9)
+
+
 def lambdarank(preds: Array, labels: Array, weights=None,
                group_ids: Array = None, max_label: int = 31,
                sigmoid: float = 1.0, truncation_level: int = 30,
-               label_gain=None):
+               label_gain=None, group_layout=None):
     """LambdaMART gradients with NDCG delta weighting.
 
     The reference delegates this to LightGBM C++ (objective
-    ``lambdarank``); here it is an O(N^2)-within-masked-window pairwise
-    computation vectorized over the whole (padded) batch: pairs are valid
-    only within the same query group. Suitable for group sizes up to a few
-    hundred (MSLR-scale); larger groups should raise ``truncation_level``
-    semantics instead.
+    ``lambdarank``). With ``group_layout`` (the trainer always passes
+    one, via :func:`make_group_layout`) pairs are computed per group in
+    a padded (G, S, S) bucket layout — cost G*S^2, i.e. linear in rows
+    for bounded group sizes, the shape that scales to MSLR-sized data.
+    Without a layout (direct callers) it falls back to the (N, N)
+    whole-batch pairwise formulation, suitable only for small N.
     """
-    if group_ids is None:
+    if group_ids is None and group_layout is None:
         raise ValueError("lambdarank requires group_ids")
+    if group_layout is not None:
+        grad, hess = _lambdarank_bucketed(
+            preds, labels, group_layout, sigmoid, truncation_level,
+            label_gain)
+        return _weighted(grad, hess, weights)
     if label_gain is not None:
         # explicit per-relevance gains (LightGBM label_gain)
         lg = jnp.asarray(label_gain, preds.dtype)

@@ -908,6 +908,8 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
         okw = dict(obj_kwargs)
         if cfg.objective == "lambdarank":
             okw["group_ids"] = groups
+            if data.get("group_layout") is not None:
+                okw["group_layout"] = data["group_layout"]
         g, h = objective_fn(score_in, labels, weights, **okw)
 
         if is_goss:
@@ -1143,6 +1145,15 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
             np.asarray(weights, dtype=np.float32))
         row_valid_d = None if row_valid is None else dev_put(row_valid)
     group_ids_dev = None if group_ids is None else jnp.asarray(group_ids)
+    if cfg.objective == "lambdarank" and group_ids is not None:
+        # host-computed padded (G, S) bucket layout, built ONCE from the
+        # host array: the lambdarank pairwise work runs per group,
+        # never as an (N, N) matrix
+        from mmlspark_tpu.models.gbdt.objectives import make_group_layout
+        _rows, _mask = make_group_layout(np.asarray(group_ids))
+        group_layout = (jnp.asarray(_rows), jnp.asarray(_mask))
+    else:
+        group_layout = None
 
     # raw scores, (N,) or (N,K)
     raw_shape = (n,) if k == 1 else (n, k)
@@ -1188,13 +1199,13 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
             group_ids_dev, raw, valid_states, custom_objective, mesh,
             metric_name, metric_list, higher_better, metric_kwargs,
             base_score, callbacks, measures, n, row_valid,
-            iteration_offset)
+            iteration_offset, group_layout=group_layout)
     else:
         trees, tree_weights, evals, best_iter = _train_scan(
             cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
             group_ids_dev, raw, valid_states, mesh,
             metric_list, higher_better, base_score, callbacks, measures,
-            row_valid_d, iteration_offset)
+            row_valid_d, iteration_offset, group_layout=group_layout)
     trees_sf, trees_tb, trees_nv, trees_cnt, trees_dt, trees_bgl = trees
 
     num_trees = len(trees_sf)
@@ -1276,7 +1287,7 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
 def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
                 group_ids_dev, raw, valid_states, mesh,
                 metric_list, higher_better, base_score, callbacks, measures,
-                row_valid_d=None, iteration_offset=0):
+                row_valid_d=None, iteration_offset=0, group_layout=None):
     """Fused device loop: one async dispatch per iteration, zero host
     syncs inside the loop. Early stopping syncs the (tiny) metric matrix
     in blocks of ``early_stopping_round`` and truncates post hoc — trees
@@ -1294,6 +1305,7 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
         "labels": labels_d,
         "weights": weights_d if weights_d is not None else ones,
         "groups": group_ids_dev,
+        "group_layout": group_layout,
         "row_valid": row_valid_d if row_valid_d is not None else ones,
         "base": jnp.float32(base_score),
         "key": jax.random.key(cfg.seed),
@@ -1441,7 +1453,8 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
                 weights_d, group_ids_dev, raw, valid_states,
                 custom_objective, mesh, metric_name, metric_list,
                 higher_better, metric_kwargs, base_score, callbacks,
-                measures, n, row_valid=None, iteration_offset=0):
+                measures, n, row_valid=None, iteration_offset=0,
+                group_layout=None):
     """Per-iteration eager host loop. Used for (a) DART, whose
     dropped-tree set is a dynamically sized subset of all prior trees
     that doesn't fit a fixed-shape compiled step, and (b) custom
@@ -1463,7 +1476,8 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
     if cfg.objective == "lambdarank":
         obj_kwargs = {
             "group_ids": group_ids_dev, "sigmoid": cfg.sigmoid,
-            "truncation_level": cfg.lambdarank_truncation_level}
+            "truncation_level": cfg.lambdarank_truncation_level,
+            "group_layout": group_layout}
         if cfg.label_gain:
             obj_kwargs["label_gain"] = tuple(cfg.label_gain)
     if custom_objective is not None:

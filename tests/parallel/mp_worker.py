@@ -51,6 +51,15 @@ def main() -> None:
     mesh = create_mesh()  # spans all processes: global device list
     res = train(binned, y, cfg, bin_upper=bu, mesh=mesh)
 
+    # SURVEY §2.9 maps BOTH reference rendezvous planes here: the
+    # LightGBM ring (dp-GBDT above) and the VW spanning-tree allreduce —
+    # a sharded VW fit over the same process-spanning mesh
+    vw_l2 = _vw_leg(mesh)
+    # and the long-context plane: ring attention with the sequence
+    # sharded across BOTH processes (ppermute rides the inter-process
+    # transport the way it rides ICI/DCN on a pod)
+    ring_err = _ring_leg()
+
     if jax.process_index() == 0:
         b = res.booster
         # .npz suffix on the temp name keeps np.savez from appending
@@ -60,8 +69,54 @@ def main() -> None:
                  split_feature=b.split_feature,
                  threshold_bin=b.threshold_bin,
                  node_value=b.node_value,
-                 logloss=res.evals[-1]["train_binary_logloss"])
+                 logloss=res.evals[-1]["train_binary_logloss"],
+                 vw_l2=vw_l2, ring_err=ring_err)
         os.replace(tmp, out_path)
+
+
+def _vw_leg(mesh) -> float:
+    """Sharded VW regression across the process-spanning mesh; returns
+    the training L2 (the launcher asserts it learned)."""
+    import numpy as np
+
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.vw import VowpalWabbitRegressor
+
+    rng = np.random.default_rng(9)
+    n, d = 1024, 10
+    x = rng.normal(size=(n, d))
+    w_true = rng.normal(size=d)
+    y = x @ w_true + 0.1 * rng.normal(size=n)
+    y = (y - y.mean()) / y.std()
+    df = DataFrame({"features": x, "label": y})
+    model = (VowpalWabbitRegressor(numPasses=8, learningRate=0.5,
+                                   batchSize=8, interPassSync=True)
+             .set_mesh(mesh).fit(df))
+    pred = model.transform(df)["prediction"]
+    return float(np.mean((pred - y) ** 2))
+
+
+def _ring_leg() -> float:
+    """Ring attention with the sequence sharded over ALL global
+    devices (both processes); returns max |ring - dense|."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mmlspark_tpu.parallel.attention import (dense_attention,
+                                                 ring_attention)
+    from mmlspark_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    sp = len(jax.devices())
+    sp_mesh = create_mesh(MeshConfig(dp=1, sp=sp))
+    rng = np.random.default_rng(3)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 8 * sp, 2, 4)),
+                           jnp.float32)
+               for _ in range(3))
+    ring = jax.jit(lambda a, b, c: ring_attention(
+        a, b, c, sp_mesh, causal=True))(q, k, v)
+    want = dense_attention(q, k, v, causal=True)
+    return float(jnp.max(jnp.abs(ring - want)))
 
 
 def make_fixture():
@@ -173,6 +228,10 @@ def run_and_check(num_procs: int = 2, devices_per_process: int = 4) -> None:
                                    got["node_value"], atol=1e-5)
         assert abs(res.evals[-1]["train_binary_logloss"]
                    - float(got["logloss"])) < 1e-5
+        # VW sharded fit across both processes learned the linear task
+        assert float(got["vw_l2"]) < 0.5, float(got["vw_l2"])
+        # cross-process ring attention matches dense
+        assert float(got["ring_err"]) < 1e-4, float(got["ring_err"])
 
 
 if __name__ == "__main__":
