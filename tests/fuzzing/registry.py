@@ -62,6 +62,8 @@ def _interactions():
 class TestObject:
     """A stage instance + the dataset(s) to exercise it with."""
 
+    __test__ = False  # dataclass, not a pytest collection target
+
     stage: PipelineStage
     fit_df: DataFrame
     transform_df: Optional[DataFrame] = None
