@@ -68,7 +68,8 @@ class VowpalWabbitContextualBandit(_VWBaseLearner):
                                get("learningRate"), get("powerT"),
                                get("initialT"), get("adaptive"),
                                get("l1"), get("l2"),
-                               normalized=get("normalized"))
+                               normalized=get("normalized"),
+                               invariant=get("invariant"))
         shifted = (idx.astype(np.int64)
                    + (action[:, None] * num_weights)).astype(np.int64)
         bidx, bval, by, bwt = _batchify(shifted, val, cost, wt, get("batchSize"))

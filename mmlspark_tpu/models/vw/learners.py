@@ -92,25 +92,86 @@ def jitted_sgd_train(*args, **kwargs):
     return fn
 
 
+def _invariant_delta_p(loss: str, pred, y, t_budget, quantile_tau):
+    """Closed-form importance-aware prediction shift (Karampatziakis &
+    Langford 2011, VW loss_functions.cc getUpdate): the limit of
+    infinitely many infinitesimal gradient steps whose total learning
+    "time" is ``t_budget`` = lr * importance * x'Rx. Never overshoots
+    the label, no matter how large the rate or importance weight."""
+    import jax
+    import jax.numpy as jnp
+
+    if loss == "squared":
+        # dp/dt = -(p - y)  =>  p(T) = y + (p0 - y) e^-T
+        return (y - pred) * (1.0 - jnp.exp(-t_budget))
+    if loss == "logistic":
+        # in margin space s = y_pm * p: ds/dt = sigmoid(-s), whose
+        # flow satisfies s + e^s = s0 + e^s0 + T; solve by Newton
+        # (monotone convex), exp clamped (for s>30 the update is ~0)
+        y_pm = 2.0 * y - 1.0
+        s0 = y_pm * pred
+        # clamp c finite (t_budget=inf would NaN the solver) — the
+        # root only grows logarithmically in c anyway
+        c = jnp.minimum(s0 + jnp.exp(jnp.minimum(s0, 30.0)) + t_budget,
+                        1e30)
+        init = jnp.where(c > 1.0, jnp.log(jnp.maximum(c, 1e-6)), s0)
+
+        def newton(s, _):
+            es = jnp.exp(jnp.minimum(s, 30.0))
+            return s - (s + es - c) / (1.0 + es), None
+
+        s1, _ = jax.lax.scan(newton, init, None, length=8)
+        # bracket the root: the flow is monotone non-decreasing (>= s0)
+        # and s* < log(c) for large c, so log(c)+1 is a safe upper
+        # bound — without it the exp clamp above lets Newton walk
+        # arbitrarily past the root once the margin exceeds 30
+        upper = jnp.log(jnp.maximum(c, 1e-6)) + 1.0
+        s1 = jnp.clip(s1, s0, jnp.maximum(upper, s0))
+        return (s1 - s0) * y_pm
+    if loss == "hinge":
+        # constant unit slope toward margin 1, then stops
+        y_pm = 2.0 * y - 1.0
+        s0 = y_pm * pred
+        return y_pm * jnp.minimum(t_budget, jnp.maximum(1.0 - s0, 0.0))
+    if loss == "quantile":
+        # constant slope tau / (1-tau) toward the label, never past it
+        d = pred - y
+        slope = jnp.where(d >= 0, 1.0 - quantile_tau, quantile_tau)
+        return -jnp.sign(d) * jnp.minimum(slope * t_budget, jnp.abs(d))
+    raise ValueError(f"unknown loss {loss!r}")
+
+
 def make_sgd_train(num_weights: int, loss: str, learning_rate: float,
                    power_t: float, initial_t: float, adaptive: bool,
                    l1: float, l2: float, normalized: bool = False,
+                   invariant: bool = False,
                    quantile_tau: float = 0.5, progressive: bool = False):
     """Build jittable (w, g2, scale, n_acc, bias, t0, idx, val, y, wt)
     -> updated state scanning over leading batch dim. Shapes: idx/val
     (B, W), y/wt (B,).
 
     ``normalized`` adds VW's ``--normalized`` per-feature scale
-    accumulators (the third member of native VW's default
-    adaptive+normalized+invariant update trio,
-    VowpalWabbitBaseLearner.scala driving vw gd.cc; the NAG algorithm
-    of Ross/Mineiro/Langford 2013): ``scale_i`` tracks max |x_i| seen,
-    weights are squashed when a feature's scale grows, per-feature
-    learning rates divide by the scale, and a global ``(t/N)^power_t``
-    factor (N = accumulated normalized squared norms) restores the
-    effective rate. Net effect: predictions are invariant to
-    per-feature rescaling of the input — pinned by
+    accumulators (VowpalWabbitBaseLearner.scala driving vw gd.cc; the
+    NAG algorithm of Ross/Mineiro/Langford 2013): ``scale_i`` tracks
+    max |x_i| seen, weights are squashed when a feature's scale grows,
+    per-feature learning rates divide by the scale, and a global
+    ``(t/N)^power_t`` factor (N = accumulated normalized squared
+    norms) restores the effective rate. Net effect: predictions are
+    invariant to per-feature rescaling of the input — pinned by
     tests/vw/test_vw.py::test_normalized_scale_invariance.
+
+    ``invariant`` adds VW's ``--invariant`` importance-aware updates
+    (the remaining member of native VW's default
+    adaptive+normalized+invariant trio): per example, the closed-form
+    prediction shift of :func:`_invariant_delta_p` is distributed over
+    the features proportionally to ``x_i * r_i`` (r = the per-feature
+    rate metric from adaptive/normalized state), so huge importance
+    weights or learning rates saturate at the label instead of
+    overshooting — pinned by
+    tests/vw/test_vw.py::test_invariant_importance_aware. Exact online
+    semantics at batchSize=1; larger batches apply the per-row closed
+    form against the batch-start weights (minibatch approximation,
+    same contract as the gradient path).
     """
     import jax
     import jax.numpy as jnp
@@ -155,27 +216,47 @@ def make_sgd_train(num_weights: int, loss: str, learning_rate: float,
             nf = (jnp.maximum(t + 1.0, 1.0)
                   / jnp.maximum(n_acc, 1e-8)) ** power_t
             lr_t = lr_t * nf
-        if adaptive:
-            if normalized:
-                # accumulate AdaGrad state in NORMALIZED gradient units
-                # (g/s is invariant to per-feature rescaling), so the
-                # 1e-8 epsilon compares against a scale-free quantity —
-                # accumulating raw g^2 ~ c^2 would let the epsilon
-                # distort small-scale features and break invariance
-                sg = jnp.where(s > 0, s, 1.0)
-                gn = gw / sg
-                g2 = g2 + gn * gn
-                w = w - lr_t * (gn / sg) / jnp.sqrt(g2 + 1e-8)
-            else:
-                g2 = g2 + gw * gw
-                w = w - lr_t * gw / jnp.sqrt(g2 + 1e-8)
+        # per-feature rate metric r: the update direction is always
+        # gradient * r (gradient path) or x * r (invariant path)
+        if adaptive and normalized:
+            # accumulate AdaGrad state in NORMALIZED gradient units
+            # (g/s is invariant to per-feature rescaling), so the
+            # 1e-8 epsilon compares against a scale-free quantity —
+            # accumulating raw g^2 ~ c^2 would let the epsilon
+            # distort small-scale features and break invariance
+            sg = jnp.where(s > 0, s, 1.0)
+            gn = gw / sg
+            g2 = g2 + gn * gn
+            r = 1.0 / (sg * sg * jnp.sqrt(g2 + 1e-8))
+        elif adaptive:
+            g2 = g2 + gw * gw
+            r = 1.0 / jnp.sqrt(g2 + 1e-8)
+        elif normalized:
+            r = 1.0 / jnp.where(s > 0, s * s, 1.0)
         else:
-            if normalized:
-                gw = gw / jnp.where(s > 0, s * s, 1.0)
-            w = w - lr_t * gw
+            r = None  # unit rates; avoid a num_weights-sized constant
+        if invariant:
+            # closed-form importance-aware step: shift the prediction
+            # by delta_p (never past the label) and distribute it over
+            # the example's features as Delta w_i = delta_p x_i r_i /
+            # (x'Rx), so sum_i Delta w_i x_i = delta_p exactly. The
+            # bias rides as a constant feature at unit rate (the +1).
+            rj = jnp.ones_like(val) if r is None else r[idx]
+            xrx = jnp.sum(val * val * rj, axis=-1) + 1.0
+            t_budget = lr_t * wt * xrx
+            delta_p = _invariant_delta_p(loss, pred, y, t_budget,
+                                         quantile_tau)
+            coeff = delta_p / xrx
+            w = w + jnp.zeros_like(w).at[idx.reshape(-1)].add(
+                (coeff[:, None] * val * rj).reshape(-1) / batch_n)
+            if l2:
+                w = w - lr_t * l2 * (w if r is None else w * r)
+            bias = bias + jnp.sum(coeff) / batch_n
+        else:
+            w = w - lr_t * (gw if r is None else gw * r)
+            bias = bias - lr_t * gb
         if l1:
             w = jnp.sign(w) * jnp.maximum(jnp.abs(w) - lr_t * l1, 0.0)
-        bias = bias - lr_t * gb
         out = pred if progressive else jnp.zeros(())
         return (w, g2, s, n_acc, bias, t + 1.0), out
 
@@ -223,9 +304,12 @@ class _VWParams(HasLabelCol, HasWeightCol, HasPredictionCol):
                      to_bool, default=False)
     normalized = Param(
         "normalized", "per-feature scale-invariant updates "
-        "(--normalized; with adaptive, two thirds of native VW's "
-        "default adaptive+normalized+invariant trio — invariant-style "
-        "power_t decay is always on here)", to_bool, default=False)
+        "(--normalized)", to_bool, default=False)
+    invariant = Param(
+        "invariant", "importance-aware closed-form updates that never "
+        "overshoot the label (--invariant); adaptive+normalized+"
+        "invariant together reproduce native VW's default update "
+        "family", to_bool, default=False)
     l1 = Param("l1", "L1 regularization", to_float, ge(0), default=0.0)
     l2 = Param("l2", "L2 regularization", to_float, ge(0), default=0.0)
     batchSize = Param("batchSize", "rows per online update (1 = exact "
@@ -262,6 +346,8 @@ class _VWParams(HasLabelCol, HasWeightCol, HasPredictionCol):
                 out["adaptive"] = True
             elif a == "--normalized":
                 out["normalized"] = True
+            elif a == "--invariant":
+                out["invariant"] = True
             elif a in ("-l", "--learning_rate"):
                 out["learningRate"] = float(take())
             elif a == "--power_t":
@@ -322,7 +408,8 @@ class _VWBaseLearner(Estimator, _VWParams):
         sgd_args = (num_weights, self._loss, get("learningRate"),
                     get("powerT"), get("initialT"), get("adaptive"),
                     get("l1"), get("l2"))
-        sgd_kwargs = dict(normalized=get("normalized"), quantile_tau=0.5,
+        sgd_kwargs = dict(normalized=get("normalized"),
+                          invariant=get("invariant"), quantile_tau=0.5,
                           progressive=progressive)
         bidx, bval, by, bwt = _batchify(idx, val, y, wt, get("batchSize"))
         mesh = self._mesh
