@@ -109,7 +109,8 @@ def distributed_init(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None,
                      local_device_ids: Optional[Sequence[int]] = None,
-                     cpu_devices_per_process: Optional[int] = None) -> None:
+                     cpu_devices_per_process: Optional[int] = None,
+                     **kwargs) -> None:
     """Join (or bootstrap) a multi-process JAX cluster.
 
     This is the rendezvous the reference implements by hand twice —
@@ -132,6 +133,14 @@ def distributed_init(coordinator_address: Optional[str] = None,
     devices *before* the backend initializes — the offline multi-host
     test rig (N processes x M virtual CPU devices; collectives ride
     Gloo). Production TPU processes leave it ``None``.
+
+    Extra keyword arguments pass through to
+    ``jax.distributed.initialize`` (e.g. ``heartbeat_timeout_seconds``,
+    which bounds how long survivors wait before a dead peer is
+    detected and the process fail-fast terminates — the barrier
+    failure-detection analog of the reference's socket-error
+    propagation, pinned by
+    tests/parallel/test_multihost.py::test_dead_rank_fails_fast).
     """
     import jax
 
@@ -142,7 +151,8 @@ def distributed_init(coordinator_address: Optional[str] = None,
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
-        local_device_ids=local_device_ids)
+        local_device_ids=local_device_ids,
+        **kwargs)
 
 
 def process_index() -> int:

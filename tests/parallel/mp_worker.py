@@ -34,9 +34,14 @@ def main() -> None:
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
     from mmlspark_tpu.parallel.mesh import create_mesh, distributed_init
 
+    init_kwargs = {}
+    if os.environ.get("MP_WORKER_HEARTBEAT"):
+        init_kwargs["heartbeat_timeout_seconds"] = int(
+            os.environ["MP_WORKER_HEARTBEAT"])
     distributed_init(coordinator_address=f"127.0.0.1:{port}",
                      num_processes=num_procs, process_id=proc_id,
-                     cpu_devices_per_process=devices_per_process)
+                     cpu_devices_per_process=devices_per_process,
+                     **init_kwargs)
 
     import jax
     import numpy as np
@@ -48,7 +53,13 @@ def main() -> None:
     from mmlspark_tpu.models.gbdt import train
 
     binned, y, bu, cfg = make_fixture()
+    if os.environ.get("MP_WORKER_ITERS"):
+        # failure-detection rig: a fit long enough to be killed mid-way
+        import dataclasses
+        cfg = dataclasses.replace(
+            cfg, num_iterations=int(os.environ["MP_WORKER_ITERS"]))
     mesh = create_mesh()  # spans all processes: global device list
+    print(f"[rank {proc_id}] fit starting", flush=True)
     res = train(binned, y, cfg, bin_upper=bu, mesh=mesh)
 
     # SURVEY §2.9 maps BOTH reference rendezvous planes here: the

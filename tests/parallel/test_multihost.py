@@ -25,3 +25,64 @@ def test_two_process_dp_training_matches_single():
     finally:
         sys.path.pop(0)
     run_and_check(num_procs=2, devices_per_process=4)
+
+
+def test_dead_rank_fails_fast(tmp_path):
+    """Failure detection (SURVEY §5): when a rank dies mid-fit, the
+    surviving rank must fail fast with a diagnostic naming the dead
+    task — not hang in the collective forever. The reference detects
+    this through socket errors in its hand-rolled ring
+    (NetworkManager.scala); here the jax.distributed coordination
+    service's heartbeat does, within heartbeat_timeout_seconds."""
+    import signal
+    import socket
+    import subprocess
+    import time
+
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["MP_WORKER_ITERS"] = "20000"     # hours of fit — never finishes
+    env["MP_WORKER_HEARTBEAT"] = "10"
+    worker = os.path.join(HERE, "mp_worker.py")
+    out = str(tmp_path / "unused.npz")
+    logs = [str(tmp_path / f"rank{r}.log") for r in range(2)]
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(r), "2", str(port), out, "4"],
+        stdout=open(logs[r], "w"), stderr=subprocess.STDOUT, env=env)
+        for r in range(2)]
+    try:
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if "fit starting" in open(logs[1]).read():
+                break
+            assert procs[1].poll() is None, (
+                "rank 1 died before fit:\n" + open(logs[1]).read()[-3000:])
+            time.sleep(2)
+        else:
+            raise AssertionError(
+                "rank 1 never started fitting:\n"
+                + open(logs[1]).read()[-3000:])
+        time.sleep(5)  # let iterations run inside the collective loop
+        procs[1].send_signal(signal.SIGKILL)
+        t0 = time.time()
+        try:
+            rc0 = procs[0].wait(timeout=120)  # heartbeat 10s + slack
+        except subprocess.TimeoutExpired:
+            raise AssertionError(
+                "rank 0 hung after rank 1 died (no failure detection)")
+        detect = time.time() - t0
+        assert rc0 != 0, "rank 0 exited cleanly despite a dead peer"
+        log0 = open(logs[0]).read()
+        assert ("unhealthy" in log0 or "heartbeat" in log0
+                or "task died" in log0.lower()), log0[-2000:]
+        # detection must be bounded by the configured heartbeat window
+        assert detect < 120, detect
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
