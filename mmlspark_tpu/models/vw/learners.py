@@ -458,11 +458,27 @@ class _VWBaseLearner(Estimator, _VWParams):
                 out_specs=(P(), P(), P(), P(), P(), P(), batch_spec)))
         else:
             run_pass = jitted_sgd_train(*sgd_args, **sgd_kwargs)
-        w = jnp.zeros(num_weights, dtype=jnp.float32)
-        g2 = jnp.zeros(num_weights, dtype=jnp.float32)
-        s = jnp.zeros(num_weights, dtype=jnp.float32)
+        init = getattr(self, "_initial_model", None)
+        if init is not None and init.weights is not None:
+            if len(init.weights) != num_weights:
+                raise ValueError(
+                    f"initial model has {len(init.weights)} weights; this "
+                    f"learner's numBits gives {num_weights} — they must "
+                    "match (same hash space)")
+            w = jnp.asarray(init.weights, dtype=jnp.float32)
+            bias = jnp.asarray(np.float32(init.bias))
+            ig2 = getattr(init, "g2", None)
+            isc = getattr(init, "scale", None)
+            g2 = (jnp.asarray(ig2, jnp.float32) if ig2 is not None
+                  else jnp.zeros(num_weights, dtype=jnp.float32))
+            s = (jnp.asarray(isc, jnp.float32) if isc is not None
+                 else jnp.zeros(num_weights, dtype=jnp.float32))
+        else:
+            w = jnp.zeros(num_weights, dtype=jnp.float32)
+            g2 = jnp.zeros(num_weights, dtype=jnp.float32)
+            s = jnp.zeros(num_weights, dtype=jnp.float32)
+            bias = jnp.zeros(())
         n_acc = jnp.zeros(())
-        bias = jnp.zeros(())
         t = jnp.ones(()) * 0.0
         all_preds = []
         nb_total = bidx.shape[0]
@@ -534,12 +550,23 @@ class _VWBaseLearner(Estimator, _VWParams):
             per = (margin - y) ** 2
         return float((per * wt).sum() / max(wt.sum(), 1e-12))
 
+    def set_initial_model(self, model: "_VWBaseModel") -> "_VWBaseLearner":
+        """Warm start from a fitted model (VW ``initialModel`` / the
+        ``-i`` model file, VowpalWabbitBase.scala:89): the fit begins
+        from its weights/bias — and its optimizer state (AdaGrad
+        accumulators, normalization scales) when the model carries it,
+        matching VW model files which persist the adaptive state."""
+        self._initial_model = model
+        return self
+
     def _make_model(self, model_cls, state):
         model = model_cls(**{k: v for k, v in self._paramMap.items()
                              if model_cls.has_param(k)})
         model.weights = state["weights"]
         model.bias = state["bias"]
         model.loss = state["loss"]
+        model.g2 = state.get("g2")
+        model.scale = state.get("scale")
         model.train_stats = state.get("stats")
         return model
 
@@ -549,17 +576,31 @@ class _VWBaseModel(Model, _VWParams):
     bias: float = 0.0
     loss: str = "squared"
     train_stats: Optional[Dict[str, Any]] = None
+    # optimizer state, persisted like VW model files persist the
+    # adaptive state — a reloaded model warm-starts identically
+    g2: Optional[np.ndarray] = None
+    scale: Optional[np.ndarray] = None
 
     rawPredictionCol = Param("rawPredictionCol", "margin column", to_str,
                              default="rawPrediction")
 
     def _get_state(self):
-        return {"weights": self.weights, "bias": self.bias, "loss": self.loss}
+        state = {"weights": self.weights, "bias": self.bias,
+                 "loss": self.loss}
+        if self.g2 is not None:
+            state["g2"] = self.g2
+        if self.scale is not None:
+            state["scale"] = self.scale
+        return state
 
     def _set_state(self, state):
         self.weights = np.asarray(state["weights"])
         self.bias = float(state["bias"])
         self.loss = state["loss"]
+        self.g2 = (np.asarray(state["g2"]) if state.get("g2") is not None
+                   else None)
+        self.scale = (np.asarray(state["scale"])
+                      if state.get("scale") is not None else None)
 
     def _margin(self, df: DataFrame) -> np.ndarray:
         base = self.get("featuresCol")

@@ -178,6 +178,44 @@ def test_invariant_logistic_huge_rate():
     assert roc_auc_score(yb, probs) > 0.9
 
 
+def test_initial_model_warm_start(tmp_path):
+    """VW initialModel (-i): a fit seeded from a previous model starts
+    where it left off — its first-pass loss is far below a cold fit's
+    first-pass loss, and the optimizer state survives save/load."""
+    df = regression_df()
+    cold = VowpalWabbitRegressor(numPasses=4, learningRate=0.5,
+                                 adaptive=True, normalized=True,
+                                 batchSize=8)
+    m1 = cold.fit(df)
+    cold_first = m1.get_performance_statistics()["avgTrainLossPerPass"][0]
+
+    warm = (VowpalWabbitRegressor(numPasses=1, learningRate=0.5,
+                                  adaptive=True, normalized=True,
+                                  batchSize=8).set_initial_model(m1))
+    m2 = warm.fit(df)
+    warm_first = m2.get_performance_statistics()["avgTrainLossPerPass"][0]
+    assert warm_first < cold_first * 0.5, (warm_first, cold_first)
+
+    # optimizer state survives persistence: warm start from a RELOADED
+    # model behaves the same
+    path = str(tmp_path / "vw-model")
+    m1.save(path)
+    from mmlspark_tpu.core.pipeline import PipelineStage
+    reloaded = PipelineStage.load(path)
+    assert reloaded.g2 is not None and reloaded.scale is not None
+    m3 = (VowpalWabbitRegressor(numPasses=1, learningRate=0.5,
+                                adaptive=True, normalized=True,
+                                batchSize=8)
+          .set_initial_model(reloaded).fit(df))
+    np.testing.assert_allclose(
+        m3.transform(df)["prediction"], m2.transform(df)["prediction"],
+        rtol=1e-5, atol=1e-6)
+
+    # hash-space mismatch is a clear error, not silent corruption
+    with pytest.raises(ValueError, match="numBits"):
+        VowpalWabbitRegressor(numBits=10).set_initial_model(m1).fit(df)
+
+
 def test_normalized_pass_through_flag():
     df = regression_df()
     m = VowpalWabbitRegressor(
