@@ -391,14 +391,21 @@ class _VWBaseLearner(Estimator, _VWParams):
             val = x.astype(np.float32)
         return idx, sanitize_values(val)
 
-    def _train_weights(self, df: DataFrame, progressive: bool = False):
+    def _train_weights(self, df: DataFrame, progressive: bool = False,
+                       labels_override=None, features_override=None):
         import jax
         import jax.numpy as jnp
 
         overrides = self._apply_pass_through()
         get = lambda k: overrides.get(k, self.get(k))
-        idx, val = self._get_features(df)
-        y = np.asarray(df.col(self.get("labelCol")), dtype=np.float32)
+        # overrides let one-vs-all reuse one feature extraction across
+        # its K sub-fits (only the label vector differs)
+        idx, val = (features_override if features_override is not None
+                    else self._get_features(df))
+        y = (np.asarray(labels_override, dtype=np.float32)
+             if labels_override is not None
+             else np.asarray(df.col(self.get("labelCol")),
+                             dtype=np.float32))
         wt = (np.asarray(df.col(self.get("weightCol")), dtype=np.float32)
               if self.is_set("weightCol") else np.ones(len(y), np.float32))
         num_weights = 1 << get("numBits")
@@ -460,12 +467,18 @@ class _VWBaseLearner(Estimator, _VWParams):
             run_pass = jitted_sgd_train(*sgd_args, **sgd_kwargs)
         init = getattr(self, "_initial_model", None)
         if init is not None and init.weights is not None:
-            if len(init.weights) != num_weights:
+            iw = np.asarray(init.weights)
+            if iw.ndim != 1:
                 raise ValueError(
-                    f"initial model has {len(init.weights)} weights; this "
+                    "initial model carries multi-bank (one-vs-all) "
+                    "weights; only single-bank models can warm start "
+                    "a single-bank learner")
+            if len(iw) != num_weights:
+                raise ValueError(
+                    f"initial model has {len(iw)} weights; this "
                     f"learner's numBits gives {num_weights} — they must "
                     "match (same hash space)")
-            w = jnp.asarray(init.weights, dtype=jnp.float32)
+            w = jnp.asarray(iw, dtype=jnp.float32)
             bias = jnp.asarray(np.float32(init.bias))
             ig2 = getattr(init, "g2", None)
             isc = getattr(init, "scale", None)
@@ -473,13 +486,20 @@ class _VWBaseLearner(Estimator, _VWParams):
                   else jnp.zeros(num_weights, dtype=jnp.float32))
             s = (jnp.asarray(isc, jnp.float32) if isc is not None
                  else jnp.zeros(num_weights, dtype=jnp.float32))
+            # resume the schedule counters too (VW --save_resume
+            # persists example counters so lr decay and the normalized
+            # global factor continue instead of restarting hot)
+            n_acc = jnp.asarray(np.float32(getattr(init, "n_acc", 0.0)
+                                           or 0.0))
+            t = jnp.asarray(np.float32(getattr(init, "t_count", 0.0)
+                                       or 0.0))
         else:
             w = jnp.zeros(num_weights, dtype=jnp.float32)
             g2 = jnp.zeros(num_weights, dtype=jnp.float32)
             s = jnp.zeros(num_weights, dtype=jnp.float32)
             bias = jnp.zeros(())
-        n_acc = jnp.zeros(())
-        t = jnp.ones(()) * 0.0
+            n_acc = jnp.zeros(())
+            t = jnp.ones(()) * 0.0
         all_preds = []
         nb_total = bidx.shape[0]
         ndev = 1
@@ -522,6 +542,8 @@ class _VWBaseLearner(Estimator, _VWParams):
             "weights": np.asarray(w),
             "g2": np.asarray(g2),
             "scale": np.asarray(s),
+            "t_count": float(t),
+            "n_acc": float(n_acc),
             "bias": float(bias),
             "loss": self._loss,
             "stats": {
@@ -567,6 +589,8 @@ class _VWBaseLearner(Estimator, _VWParams):
         model.loss = state["loss"]
         model.g2 = state.get("g2")
         model.scale = state.get("scale")
+        model.t_count = float(state.get("t_count") or 0.0)
+        model.n_acc = float(state.get("n_acc") or 0.0)
         model.train_stats = state.get("stats")
         return model
 
@@ -576,17 +600,21 @@ class _VWBaseModel(Model, _VWParams):
     bias: float = 0.0
     loss: str = "squared"
     train_stats: Optional[Dict[str, Any]] = None
-    # optimizer state, persisted like VW model files persist the
-    # adaptive state — a reloaded model warm-starts identically
+    # optimizer state, persisted like VW --save_resume persists the
+    # adaptive state and example counters — a reloaded model
+    # warm-starts identically
     g2: Optional[np.ndarray] = None
     scale: Optional[np.ndarray] = None
+    t_count: float = 0.0
+    n_acc: float = 0.0
 
     rawPredictionCol = Param("rawPredictionCol", "margin column", to_str,
                              default="rawPrediction")
 
     def _get_state(self):
         state = {"weights": self.weights, "bias": self.bias,
-                 "loss": self.loss}
+                 "loss": self.loss, "t_count": self.t_count,
+                 "n_acc": self.n_acc}
         if self.g2 is not None:
             state["g2"] = self.g2
         if self.scale is not None:
@@ -601,6 +629,8 @@ class _VWBaseModel(Model, _VWParams):
                    else None)
         self.scale = (np.asarray(state["scale"])
                       if state.get("scale") is not None else None)
+        self.t_count = float(state.get("t_count", 0.0) or 0.0)
+        self.n_acc = float(state.get("n_acc", 0.0) or 0.0)
 
     def _margin(self, df: DataFrame) -> np.ndarray:
         base = self.get("featuresCol")
@@ -645,31 +675,144 @@ class VowpalWabbitRegressionModel(_VWBaseModel):
 
 
 class VowpalWabbitClassifier(_VWBaseLearner):
-    """Binary logistic classifier (VowpalWabbitClassifier.scala:1)."""
+    """Binary logistic classifier; ``numClasses > 2`` trains
+    one-vs-all — the engine-side form of the ``--oaa`` argument the
+    reference forwards for its ``numClasses`` param
+    (VowpalWabbitClassifier.scala:43)."""
 
     _loss = "logistic"
     lossFunction = Param("lossFunction", "logistic | hinge", to_str,
                          one_of("logistic", "hinge"), default="logistic")
+    numClasses = Param("numClasses", "class count; > 2 trains "
+                       "one-vs-all (--oaa)", to_int, ge(2), default=2)
 
     def _fit(self, df: DataFrame) -> "VowpalWabbitClassificationModel":
         self._loss = self.get("lossFunction")
-        state, _ = self._train_weights(df)
-        return self._make_model(VowpalWabbitClassificationModel, state)
+        k = self.get("numClasses")
+        if k == 2:
+            # labelConversion analog (VowpalWabbitClassifier.scala:37):
+            # any two distinct label values train as {0,1} and predict
+            # back as the originals; more than two is a config error
+            y = np.asarray(df.col(self.get("labelCol")))
+            classes = np.unique(y)
+            if len(classes) > 2:
+                raise ValueError(
+                    f"numClasses=2 but the label column holds "
+                    f"{len(classes)} distinct values")
+            decode = None
+            if len(classes) == 2 \
+                    and not np.array_equal(classes, [0.0, 1.0]):
+                df = df.with_column(
+                    self.get("labelCol"),
+                    (y == classes[1]).astype(np.float64))
+                decode = classes.astype(np.float64)
+            state, _ = self._train_weights(df)
+            model = self._make_model(VowpalWabbitClassificationModel,
+                                     state)
+            model.binary_classes_ = decode
+            return model
+        if getattr(self, "_initial_model", None) is not None:
+            raise NotImplementedError(
+                "initialModel warm start is binary-only; fit the "
+                "one-vs-all classes separately to warm start them")
+        y = np.asarray(df.col(self.get("labelCol")))
+        classes = np.unique(y)
+        if len(classes) > k:
+            raise ValueError(
+                f"numClasses={k} but the label column holds "
+                f"{len(classes)} distinct values")
+        feats = self._get_features(df)  # hash once, share across banks
+        per_class = []
+        for c in classes:
+            state_c, _ = self._train_weights(
+                df, labels_override=(y == c).astype(np.float32),
+                features_override=feats)
+            per_class.append(state_c)
+        all_stats = [s.get("stats") or {} for s in per_class]
+        stats = {
+            "numExamples": all_stats[0].get("numExamples"),
+            "numPasses": all_stats[0].get("numPasses"),
+            "syncsPerPass": all_stats[0].get("syncsPerPass"),
+            # wall clock sums over the K one-vs-all fits; losses are
+            # reported per class (per-pass lists), not averaged away
+            "trainSeconds": float(sum(st.get("trainSeconds") or 0.0
+                                      for st in all_stats)),
+            "avgTrainLossPerPassPerClass": [
+                st.get("avgTrainLossPerPass") for st in all_stats],
+        }
+        state = {
+            "weights": np.stack([s["weights"] for s in per_class]),
+            "bias": 0.0,
+            "loss": self._loss,
+            "stats": stats,
+        }
+        model = self._make_model(VowpalWabbitClassificationModel, state)
+        model.biases = np.asarray([s["bias"] for s in per_class])
+        model.classes_ = classes.astype(np.float64)
+        return model
 
 
 class VowpalWabbitClassificationModel(_VWBaseModel):
     probabilityCol = Param("probabilityCol", "probability column", to_str,
                            default="probability")
+    # one-vs-all state: weights becomes (K, num_weights), with
+    # per-class biases and the original label values
+    biases: Optional[np.ndarray] = None
+    classes_: Optional[np.ndarray] = None
+    # binary labelConversion decode: (2,) original label values
+    binary_classes_: Optional[np.ndarray] = None
+
+    def _get_state(self):
+        state = super()._get_state()
+        if self.classes_ is not None:
+            state["biases"] = self.biases
+            state["classes_"] = self.classes_
+        if self.binary_classes_ is not None:
+            state["binary_classes_"] = self.binary_classes_
+        return state
+
+    def _set_state(self, state):
+        super()._set_state(state)
+        c = state.get("classes_")
+        self.classes_ = None if c is None else np.asarray(c)
+        b = state.get("biases")
+        self.biases = None if b is None else np.asarray(b)
+        bc = state.get("binary_classes_")
+        self.binary_classes_ = None if bc is None else np.asarray(bc)
+
+    def _oaa_margins(self, df: DataFrame) -> np.ndarray:
+        base = self.get("featuresCol")
+        if f"{base}_idx" in df:
+            idx = df.col(f"{base}_idx").astype(np.int64)
+            val = sanitize_values(df.col(f"{base}_val").astype(np.float64))
+            return np.stack([(w[idx] * val).sum(axis=1) + b
+                             for w, b in zip(self.weights, self.biases)],
+                            axis=1)
+        x = sanitize_values(df.col(base).astype(np.float64))
+        return x @ self.weights[:, :x.shape[1]].T + self.biases[None, :]
 
     def _transform(self, df: DataFrame) -> DataFrame:
+        if self.classes_ is not None:  # one-vs-all
+            margins = self._oaa_margins(df)
+            e = np.exp(margins - margins.max(axis=1, keepdims=True))
+            probs = e / e.sum(axis=1, keepdims=True)
+            pred = self.classes_[np.argmax(margins, axis=1)]
+            return (df.with_column(self.get("rawPredictionCol"), margins)
+                      .with_column(self.get("probabilityCol"), probs)
+                      .with_column(self.get("predictionCol"),
+                                   pred.astype(np.float64)))
         margin = self._margin(df)
         prob = 1.0 / (1.0 + np.exp(-margin))
+        pred01 = (margin > 0).astype(np.int64)
+        pred = (self.binary_classes_[pred01]
+                if self.binary_classes_ is not None
+                else pred01.astype(np.float64))
         return (df.with_column(self.get("rawPredictionCol"),
                                np.stack([-margin, margin], axis=1))
                   .with_column(self.get("probabilityCol"),
                                np.stack([1 - prob, prob], axis=1))
                   .with_column(self.get("predictionCol"),
-                               (margin > 0).astype(np.float64)))
+                               pred.astype(np.float64)))
 
 
 class VowpalWabbitGeneric(_VWBaseLearner):

@@ -178,6 +178,39 @@ def test_invariant_logistic_huge_rate():
     assert roc_auc_score(yb, probs) > 0.9
 
 
+def test_multiclass_oaa(tmp_path):
+    """numClasses > 2 trains one-vs-all (the reference forwards --oaa,
+    VowpalWabbitClassifier.scala:43): 3-class linearly separable data,
+    accuracy + save/load round trip with original label values."""
+    rng = np.random.default_rng(4)
+    n, d, k = 1200, 8, 3
+    X = rng.normal(size=(n, d))
+    W = rng.normal(size=(k, d)) * 2.0
+    y_idx = np.argmax(X @ W.T + 0.3 * rng.normal(size=(n, k)), axis=1)
+    labels = np.array([10.0, 20.0, 30.0])[y_idx]  # non-contiguous values
+    df = DataFrame({"features": X, "label": labels})
+    clf = VowpalWabbitClassifier(numClasses=3, numPasses=8,
+                                 learningRate=0.5, adaptive=True,
+                                 normalized=True, batchSize=16)
+    model = clf.fit(df)
+    out = model.transform(df)
+    acc = (out["prediction"] == labels).mean()
+    assert acc > 0.9, acc
+    probs = np.asarray(out["probability"])
+    assert probs.shape == (n, 3)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+
+    path = str(tmp_path / "vw-oaa")
+    model.save(path)
+    from mmlspark_tpu.core.pipeline import PipelineStage
+    re = PipelineStage.load(path)
+    np.testing.assert_array_equal(re.transform(df)["prediction"],
+                                  out["prediction"])
+
+    with pytest.raises(ValueError, match="distinct"):
+        VowpalWabbitClassifier(numClasses=2).fit(df)
+
+
 def test_initial_model_warm_start(tmp_path):
     """VW initialModel (-i): a fit seeded from a previous model starts
     where it left off — its first-pass loss is far below a cold fit's
