@@ -325,8 +325,76 @@ def _build_op_table():
         "Min": lambda v, n, a: jnp.minimum(v[0], v[1]),
         "Max": lambda v, n, a: jnp.maximum(v[0], v[1]),
         "Sum": lambda v, n, a: sum(v[1:], v[0]),
+        # -- long tail of simple ops (round-5 robustness batch) ----------
+        "Floor": lambda v, n, a: jnp.floor(v[0]),
+        "Ceil": lambda v, n, a: jnp.ceil(v[0]),
+        "Round": lambda v, n, a: jnp.round(v[0]),  # banker's, as ONNX
+        "Reciprocal": lambda v, n, a: 1.0 / v[0],
+        "Sign": lambda v, n, a: jnp.sign(v[0]),
+        "Not": lambda v, n, a: jnp.logical_not(v[0]),
+        "And": lambda v, n, a: jnp.logical_and(v[0], v[1]),
+        "Or": lambda v, n, a: jnp.logical_or(v[0], v[1]),
+        "Xor": lambda v, n, a: jnp.logical_xor(v[0], v[1]),
+        "GreaterOrEqual": lambda v, n, a: v[0] >= v[1],
+        "LessOrEqual": lambda v, n, a: v[0] <= v[1],
+        "Mod": lambda v, n, a: (jnp.fmod(v[0], v[1]) if a.get("fmod", 0)
+                                else jnp.mod(v[0], v[1])),
+        "ReduceMin": lambda v, n, a: jnp.min(
+            v[0], axis=_reduce_axes(v, a),
+            keepdims=bool(a.get("keepdims", 1))),
+        "ReduceProd": lambda v, n, a: jnp.prod(
+            v[0], axis=_reduce_axes(v, a),
+            keepdims=bool(a.get("keepdims", 1))),
+        "ReduceL2": lambda v, n, a: jnp.sqrt(jnp.sum(
+            v[0] * v[0], axis=_reduce_axes(v, a),
+            keepdims=bool(a.get("keepdims", 1)))),
+        "ArgMin": lambda v, n, a: jnp.argmin(
+            v[0], axis=a.get("axis", 0)) if not a.get("keepdims", 1)
+            else jnp.expand_dims(jnp.argmin(v[0], axis=a.get("axis", 0)),
+                                 a.get("axis", 0)),
+        "Tile": lambda v, n, a: jnp.tile(
+            v[0], tuple(int(x) for x in np.asarray(v[1]))),
+        "CumSum": lambda v, n, a: _cumsum_op(v, a),
+        "Range": lambda v, n, a: jnp.arange(
+            np.asarray(v[0]).item(), np.asarray(v[1]).item(),
+            np.asarray(v[2]).item()),
+        "OneHot": lambda v, n, a: _onehot_op(v, a),
+        "Trilu": lambda v, n, a: (
+            jnp.triu(v[0], int(np.asarray(v[1]).item()) if len(v) > 1
+                     else 0) if a.get("upper", 1)
+            else jnp.tril(v[0], int(np.asarray(v[1]).item())
+                          if len(v) > 1 else 0)),
+        "IsNaN": lambda v, n, a: jnp.isnan(v[0]),
+        "IsInf": lambda v, n, a: jnp.isinf(v[0]),
     }
     return table
+
+
+def _cumsum_op(v, a):
+    import jax.numpy as jnp
+
+    axis = int(np.asarray(v[1]).item())
+    x = v[0]
+    if a.get("reverse", 0):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if a.get("exclusive", 0):
+        out = out - (jnp.flip(v[0], axis) if a.get("reverse", 0) else v[0])
+    if a.get("reverse", 0):
+        out = jnp.flip(out, axis)
+    return out
+
+
+def _onehot_op(v, a):
+    """indices, depth, values=[off, on]; negative indices wrap as ONNX."""
+    import jax.nn
+    import jax.numpy as jnp
+
+    depth = int(np.asarray(v[1]).item())
+    idx = jnp.where(v[0] < 0, v[0] + depth, v[0]).astype(jnp.int32)
+    oh = jax.nn.one_hot(idx, depth, axis=a.get("axis", -1))
+    off, on = v[2][0], v[2][1]
+    return oh * (on - off) + off
 
 
 class OnnxGraph:

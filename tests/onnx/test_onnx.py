@@ -94,6 +94,136 @@ def _reference_mlp(x, params):
     return logits, e / e.sum(axis=-1, keepdims=True)
 
 
+class TestLongTailOps:
+    """Round-5 simple-op batch: each converted op vs its numpy truth."""
+
+    def _run(self, nodes, inits, feeds, out_names, out_shapes=None):
+        in_vis = [_vi(k, list(v.shape)) for k, v in feeds.items()]
+        out_vis = [_vi(o, (out_shapes or {}).get(o, [None]))
+                   for o in out_names]
+        data = _model(nodes, in_vis, out_vis,
+                      [_tensor(k, v) for k, v in inits.items()])
+        run = convert_model(data).convert()
+        return run(feeds)
+
+    def test_unary_elementwise(self):
+        x = np.array([[-1.7, -0.5, 0.25, 0.5, 2.5, 3.49]], np.float32)
+        out = self._run(
+            [_node("Floor", ["x"], ["f"]), _node("Ceil", ["x"], ["c"]),
+             _node("Round", ["x"], ["r"]),
+             _node("Reciprocal", ["x"], ["rc"]),
+             _node("Sign", ["x"], ["sg"])],
+            {}, {"x": x}, ["f", "c", "r", "rc", "sg"])
+        np.testing.assert_array_equal(out["f"], np.floor(x))
+        np.testing.assert_array_equal(out["c"], np.ceil(x))
+        np.testing.assert_array_equal(out["r"], np.round(x))  # banker's
+        np.testing.assert_allclose(out["rc"], 1.0 / x, rtol=1e-6)
+        np.testing.assert_array_equal(out["sg"], np.sign(x))
+
+    def test_logic_and_comparisons(self):
+        x = np.array([[-1.0, 0.0, 2.0, 3.0]], np.float32)
+        y = np.array([[1.0, 0.0, 2.0, -3.0]], np.float32)
+        z = np.zeros((1, 4), np.float32)
+        out = self._run(
+            [_node("Greater", ["x", "z"], ["a"]),
+             _node("Greater", ["y", "z"], ["b"]),
+             _node("And", ["a", "b"], ["and_"]),
+             _node("Or", ["a", "b"], ["or_"]),
+             _node("Xor", ["a", "b"], ["xor_"]),
+             _node("Not", ["a"], ["not_"]),
+             _node("GreaterOrEqual", ["x", "y"], ["ge"]),
+             _node("LessOrEqual", ["x", "y"], ["le"])],
+            {"z": z}, {"x": x, "y": y},
+            ["and_", "or_", "xor_", "not_", "ge", "le"])
+        a, b = x > 0, y > 0
+        np.testing.assert_array_equal(out["and_"], a & b)
+        np.testing.assert_array_equal(out["or_"], a | b)
+        np.testing.assert_array_equal(out["xor_"], a ^ b)
+        np.testing.assert_array_equal(out["not_"], ~a)
+        np.testing.assert_array_equal(out["ge"], x >= y)
+        np.testing.assert_array_equal(out["le"], x <= y)
+
+    def test_mod(self):
+        x = np.array([[5.3, -5.3, 7.0]], np.float32)
+        m = np.array([[2.0, 2.0, 3.0]], np.float32)
+        out = self._run(
+            [_node("Mod", ["x", "m"], ["pymod"], fmod=0),
+             _node("Mod", ["x", "m"], ["cmod"], fmod=1)],
+            {"m": m}, {"x": x}, ["pymod", "cmod"])
+        np.testing.assert_allclose(out["pymod"], np.mod(x, m), rtol=1e-6)
+        np.testing.assert_allclose(out["cmod"], np.fmod(x, m), rtol=1e-6)
+
+    def test_reductions_and_argmin(self):
+        x = np.abs(np.random.default_rng(0).normal(
+            size=(2, 3, 4))).astype(np.float32) + 0.1
+        out = self._run(
+            [_node("ReduceMin", ["x"], ["mn"], axes=[1], keepdims=1),
+             _node("ReduceProd", ["x"], ["pr"], axes=[2], keepdims=0),
+             _node("ReduceL2", ["x"], ["l2"], axes=[1, 2], keepdims=0),
+             _node("ArgMin", ["x"], ["am"], axis=1, keepdims=0)],
+            {}, {"x": x}, ["mn", "pr", "l2", "am"])
+        np.testing.assert_allclose(out["mn"], x.min(1, keepdims=True),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(out["pr"], x.prod(2), rtol=1e-5)
+        np.testing.assert_allclose(
+            out["l2"], np.sqrt((x * x).sum((1, 2))), rtol=1e-5)
+        np.testing.assert_array_equal(out["am"], x.argmin(1))
+
+    def test_tile_cumsum_range(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        reps = np.array([2, 3], np.int64)
+        ax = np.array(1, np.int64).reshape(())
+        out = self._run(
+            [_node("Tile", ["x", "reps"], ["t"]),
+             _node("CumSum", ["x", "ax"], ["cs"]),
+             _node("CumSum", ["x", "ax"], ["cse"], exclusive=1),
+             _node("CumSum", ["x", "ax"], ["csr"], reverse=1)],
+            {"reps": reps, "ax": np.array([1], np.int64)},
+            {"x": x}, ["t", "cs", "cse", "csr"])
+        np.testing.assert_array_equal(out["t"], np.tile(x, (2, 3)))
+        np.testing.assert_allclose(out["cs"], np.cumsum(x, 1), rtol=1e-6)
+        np.testing.assert_allclose(out["cse"],
+                                   np.cumsum(x, 1) - x, rtol=1e-6)
+        np.testing.assert_allclose(
+            out["csr"], np.flip(np.cumsum(np.flip(x, 1), 1), 1),
+            rtol=1e-6)
+
+        out2 = self._run(
+            [_node("Range", ["st", "li", "de"], ["rg"])],
+            {"st": np.array([2], np.int64), "li": np.array([11], np.int64),
+             "de": np.array([3], np.int64)},
+            {"x": x}, ["rg"])
+        np.testing.assert_array_equal(np.asarray(out2["rg"]).ravel(),
+                                      np.arange(2, 11, 3))
+
+    def test_onehot_trilu_isnan(self):
+        idx = np.array([0, 2, -1, 1], np.int64)
+        out = self._run(
+            [_node("OneHot", ["idx", "depth", "vals"], ["oh"])],
+            {"depth": np.array([3], np.int64),
+             "vals": np.array([2.0, 5.0], np.float32)},
+            {"idx": idx}, ["oh"])
+        want = np.full((4, 3), 2.0, np.float32)
+        for i, j in enumerate([0, 2, 2, 1]):
+            want[i, j] = 5.0
+        np.testing.assert_array_equal(out["oh"], want)
+
+        x = np.arange(16, dtype=np.float32).reshape(4, 4)
+        out = self._run(
+            [_node("Trilu", ["x"], ["up"], upper=1),
+             _node("Trilu", ["x"], ["lo"], upper=0)],
+            {}, {"x": x}, ["up", "lo"])
+        np.testing.assert_array_equal(out["up"], np.triu(x))
+        np.testing.assert_array_equal(out["lo"], np.tril(x))
+
+        xn = np.array([[1.0, np.nan, np.inf, -np.inf]], np.float32)
+        out = self._run(
+            [_node("IsNaN", ["x"], ["nn"]), _node("IsInf", ["x"], ["inf"])],
+            {}, {"x": xn}, ["nn", "inf"])
+        np.testing.assert_array_equal(out["nn"], np.isnan(xn))
+        np.testing.assert_array_equal(out["inf"], np.isinf(xn))
+
+
 class TestConverter:
     def test_mlp_matches_numpy(self):
         rng = np.random.default_rng(0)
