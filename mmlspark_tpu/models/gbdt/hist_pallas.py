@@ -180,7 +180,12 @@ def pallas_level_histogram(binned, grad, hess, live, local, width, f, b,
             f"pallas histogram kernel supports at most {_BIN_PAD} bins, "
             f"got {b}; use the XLA formulation for wider bin counts")
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        # FORCE_COMPILE: take the Mosaic path even off-TPU — used by
+        # the AOT lowering tests to validate the exact on-TPU
+        # combination (and for debugging on TPU day)
+        from mmlspark_tpu.core.utils import env_flag
+        interpret = (jax.default_backend() != "tpu"
+                     and not env_flag("MMLSPARK_TPU_PALLAS_FORCE_COMPILE"))
     key = (int(width), int(f), int(b), int(block_rows), bool(interpret))
     if key not in _JIT_CACHE:
         w, nf, nb, br, it = key

@@ -82,10 +82,12 @@ def _check_vma() -> bool:
     checker stays on for the production path."""
     import jax
 
+    from mmlspark_tpu.core.utils import env_flag
     from mmlspark_tpu.models.gbdt.hist_pallas import (
         pallas_histogram_enabled)
     return not (pallas_histogram_enabled()
-                and jax.default_backend() != "tpu")
+                and jax.default_backend() != "tpu"
+                and not env_flag("MMLSPARK_TPU_PALLAS_FORCE_COMPILE"))
 
 
 def _histogram(binned, grad, hess, live, local, width, f, b):

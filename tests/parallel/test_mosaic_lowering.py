@@ -60,6 +60,46 @@ def test_flash_kernel_lowers_to_mosaic():
     assert "tpu_custom_call" in txt
 
 
+def test_voting_builder_with_pallas_lowers_to_mosaic(monkeypatch):
+    """The round-5 distributed path end to end, exactly as it runs on
+    TPU: shard_map over dp with check_vma ON, the pallas kernel
+    selected per-shard (FORCE_COMPILE skips the off-TPU interpret
+    fallback), lowered through Mosaic."""
+    monkeypatch.setenv("MMLSPARK_TPU_PALLAS_HIST", "1")
+    monkeypatch.setenv("MMLSPARK_TPU_PALLAS_FORCE_COMPILE", "1")
+
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models.gbdt.parallel_modes import (
+        _check_vma,
+        make_build_tree_voting,
+    )
+    from mmlspark_tpu.models.gbdt.trainer import (
+        TrainConfig,
+        _loop_only_normalized,
+    )
+    from mmlspark_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    assert _check_vma()  # the on-TPU configuration, not the fallback
+    mesh = create_mesh(MeshConfig(dp=8))
+    cfg = _loop_only_normalized(TrainConfig(
+        objective="binary", num_leaves=15, max_depth=4, max_bin=64,
+        top_k=8))
+    fn = make_build_tree_voting(8, 64, cfg, mesh)
+    n, f = 1024, 8
+    rng = np.random.default_rng(0)
+    args = (jnp.asarray(rng.integers(0, 64, size=(n, f)).astype(np.uint8)),
+            jnp.asarray(rng.normal(size=n).astype(np.float32)),
+            jnp.asarray(rng.uniform(0.1, 1, size=n).astype(np.float32)),
+            jnp.ones(n, jnp.float32),
+            jnp.ones(f, jnp.float32),
+            jnp.int32(15))
+    txt = _lower_tpu(fn, *args)
+    assert "tpu_custom_call" in txt
+    assert "shard_map" in txt or "all_reduce" in txt or "psum" in txt
+
+
 def test_lowering_check_is_not_vacuous():
     import jax
     import jax.numpy as jnp
