@@ -100,6 +100,65 @@ def test_voting_builder_with_pallas_lowers_to_mosaic(monkeypatch):
     assert "shard_map" in txt or "all_reduce" in txt or "psum" in txt
 
 
+@pytest.mark.parametrize("subtract", [False, True])
+def test_serial_builder_lowers_for_tpu(subtract):
+    """The core tree builder (XLA formulation, with and without the
+    histogram-subtraction trick) lowers for TPU — no Mosaic involved,
+    but sized-nonzero compaction and scatter shapes must pass the TPU
+    lowering rules."""
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models.gbdt.trainer import (
+        TrainConfig,
+        _loop_only_normalized,
+        make_build_tree,
+    )
+
+    cfg = _loop_only_normalized(TrainConfig(
+        objective="binary", num_leaves=31, max_depth=5, max_bin=255))
+    fn = make_build_tree(28, 255, cfg, subtract=subtract)
+    n, f = 4096, 28
+    rng = np.random.default_rng(0)
+    args = (jnp.asarray(rng.integers(0, 255, size=(n, f)).astype(np.uint8)),
+            jnp.asarray(rng.normal(size=n).astype(np.float32)),
+            jnp.asarray(rng.uniform(0.1, 1, size=n).astype(np.float32)),
+            jnp.ones(n, jnp.float32),
+            jnp.ones(f, jnp.float32),
+            jnp.int32(31))
+    txt = _lower_tpu(fn, *args)
+    assert "stablehlo" in txt or len(txt) > 1000
+
+
+def test_scoring_paths_lower_for_tpu():
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models.gbdt.booster import BoosterArrays
+
+    rng = np.random.default_rng(0)
+    trees, depth, num_f = 100, 6, 28
+    slots = 2 ** (depth + 1) - 1
+    internal = 2 ** depth - 1
+    sf = np.full((trees, slots), -1, dtype=np.int32)
+    sf[:, :internal] = rng.integers(0, num_f, size=(trees, internal))
+    tv = np.full((trees, slots), np.inf)
+    tv[:, :internal] = rng.normal(size=(trees, internal))
+    booster = BoosterArrays(
+        split_feature=sf,
+        threshold_bin=rng.integers(0, 255, size=(trees, slots)).astype(
+            np.int32),
+        threshold_value=tv,
+        node_value=rng.normal(size=(trees, slots)).astype(np.float32),
+        count=np.ones((trees, slots), np.float32),
+        tree_weights=np.ones(trees, np.float32),
+        max_depth=depth, num_features=num_f, num_class=1,
+        objective="binary", init_score=0.0)
+    x = jnp.asarray(rng.normal(size=(2048, num_f)).astype(np.float32))
+    xb = jnp.asarray(rng.integers(0, 255, size=(2048, num_f)).astype(
+        np.uint8))
+    assert len(_lower_tpu(booster.predict_fn(), x)) > 1000
+    assert len(_lower_tpu(booster.predict_binned_fn(), xb)) > 1000
+
+
 def test_lowering_check_is_not_vacuous():
     import jax
     import jax.numpy as jnp
