@@ -159,6 +159,76 @@ def test_scoring_paths_lower_for_tpu():
     assert len(_lower_tpu(booster.predict_binned_fn(), xb)) > 1000
 
 
+def test_long_context_attention_lowers_for_tpu():
+    """Ring + Ulysses attention over an sp mesh, and blockwise: the
+    long-context plane's ppermute/all_to_all collectives must pass TPU
+    lowering."""
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.parallel.attention import (
+        blockwise_attention,
+        ring_attention,
+        ulysses_attention,
+    )
+    from mmlspark_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    sp_mesh = create_mesh(MeshConfig(dp=1, sp=8))
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(
+        rng.normal(size=(1, 1024, 8, 64)).astype(np.float32))
+        for _ in range(3))
+    for fn in (lambda a, b, c: ring_attention(a, b, c, sp_mesh,
+                                              causal=True),
+               lambda a, b, c: ulysses_attention(a, b, c, sp_mesh,
+                                                 causal=True),
+               lambda a, b, c: blockwise_attention(a, b, c, causal=True)):
+        assert len(_lower_tpu(fn, q, k, v)) > 1000
+
+
+def test_vw_sharded_pass_lowers_for_tpu():
+    """The VW sharded online pass (shard_map + pmean/pmax sync) with
+    the full adaptive+normalized+invariant update family."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from mmlspark_tpu.models.vw.learners import make_sgd_train
+    from mmlspark_tpu.parallel.mesh import DATA_AXIS, create_mesh
+
+    mesh = create_mesh()
+    nw = 1 << 12
+    run = make_sgd_train(nw, "logistic", 0.5, 0.5, 1.0, True, 0.0, 0.0,
+                         normalized=True, invariant=True)
+
+    def sharded(w, g2, s, n_acc, bias, t, bi, bv, by, bw):
+        w, g2, s, n_acc, bias, t = jax.lax.pcast(
+            (w, g2, s, n_acc, bias, t), DATA_AXIS, to='varying')
+        w, g2, s, n_acc, bias, t, _ = run(w, g2, s, n_acc, bias, t,
+                                          bi, bv, by, bw)
+        return (jax.lax.pmean(w, DATA_AXIS),
+                jax.lax.pmean(g2, DATA_AXIS),
+                jax.lax.pmax(s, DATA_AXIS))
+
+    bspec = P(DATA_AXIS)
+    fn = shard_map(sharded, mesh=mesh,
+                   in_specs=(P(), P(), P(), P(), P(), P(), bspec, bspec,
+                             bspec, bspec),
+                   out_specs=(P(), P(), P()))
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    nb, bsz, wdt = 16, 8, 10
+    args = (jnp.zeros(nw, jnp.float32), jnp.zeros(nw, jnp.float32),
+            jnp.zeros(nw, jnp.float32), jnp.zeros(()), jnp.zeros(()),
+            jnp.zeros(()),
+            jnp.asarray(rng.integers(0, nw, size=(nb, bsz, wdt))
+                        .astype(np.int32)),
+            jnp.asarray(rng.normal(size=(nb, bsz, wdt)).astype(np.float32)),
+            jnp.asarray((rng.random((nb, bsz)) > 0.5).astype(np.float32)),
+            jnp.ones((nb, bsz), np.float32))
+    assert len(_lower_tpu(fn, *args)) > 1000
+
+
 def test_lowering_check_is_not_vacuous():
     import jax
     import jax.numpy as jnp
