@@ -998,6 +998,43 @@ def _get_step_fn(num_f, total_bins, cfg, k, n_valid, mode, mesh):
                                             n_valid, mode, mesh))
 
 
+def aot_lower_step(cfg: TrainConfig, n: int, num_f: int,
+                   platform: str = "tpu") -> str:
+    """AOT-lower ONE fused boosting step for ``platform`` and return
+    its StableHLO text — the exact program ``train()`` dispatches per
+    iteration (bench.py's hot loop), checkable on any host. Used by
+    tests/parallel/test_mosaic_lowering.py to gate TPU-day risk, and
+    handy on TPU day itself to inspect what XLA is given."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = _loop_only_normalized(cfg)
+    k = cfg.num_class if cfg.objective in ("multiclass", "softmax",
+                                           "multiclassova") else 1
+    step_fn = _get_step_fn(num_f, cfg.max_bin, cfg, k, 0, "serial", None)
+    rng = np.random.default_rng(0)
+    ones = jnp.ones(n, jnp.float32)
+    data = {
+        "binned": jnp.asarray(
+            rng.integers(0, cfg.max_bin, size=(n, num_f)).astype(
+                np.uint8 if cfg.max_bin <= 256 else np.int32)),
+        "labels": jnp.asarray((rng.random(n) > 0.5).astype(np.float32)),
+        "weights": ones,
+        "groups": None,
+        "group_layout": None,
+        "row_valid": ones,
+        "base": jnp.float32(0.0),
+        "key": jax.random.key(0),
+        "lr": jnp.float32(0.1),
+        "valids": (),
+    }
+    raw_shape = (n,) if k == 1 else (n, k)
+    carry = (jnp.zeros(raw_shape, jnp.float32), ())
+    # step_fn is already jitted by _make_step_fn
+    return step_fn.trace(data, carry, jnp.int32(0)).lower(
+        lowering_platforms=(platform,)).as_text()
+
+
 # ---------------------------------------------------------------------------
 # Boosting driver
 # ---------------------------------------------------------------------------

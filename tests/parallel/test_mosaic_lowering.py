@@ -229,6 +229,32 @@ def test_vw_sharded_pass_lowers_for_tpu():
     assert len(_lower_tpu(fn, *args)) > 1000
 
 
+@pytest.mark.parametrize("flags", [
+    {},
+    {"MMLSPARK_TPU_PALLAS_HIST": "1",
+     "MMLSPARK_TPU_PALLAS_FORCE_COMPILE": "1"},
+    {"MMLSPARK_TPU_HIST_SUB": "1"},
+])
+def test_full_fused_step_lowers_for_tpu(monkeypatch, flags):
+    """The ENTIRE fused boosting step (gradients -> tree build -> raw
+    update -> metrics) at bench config, in all three kernel
+    configurations tpu_day.sh will run — the exact per-iteration
+    program bench.py dispatches."""
+    for kk, vv in flags.items():
+        monkeypatch.setenv(kk, vv)
+    from mmlspark_tpu.models.gbdt.trainer import (
+        TrainConfig,
+        aot_lower_step,
+    )
+
+    cfg = TrainConfig(objective="binary", num_leaves=63, max_depth=6,
+                      max_bin=255, min_data_in_leaf=20)
+    txt = aot_lower_step(cfg, n=8192, num_f=28, platform="tpu")
+    assert len(txt) > 1000
+    if "MMLSPARK_TPU_PALLAS_HIST" in flags:
+        assert "tpu_custom_call" in txt  # the Mosaic histogram kernel
+
+
 def test_lowering_check_is_not_vacuous():
     import jax
     import jax.numpy as jnp
