@@ -255,6 +255,61 @@ def test_full_fused_step_lowers_for_tpu(monkeypatch, flags):
         assert "tpu_custom_call" in txt  # the Mosaic histogram kernel
 
 
+def test_resnet50_scoring_lowers_for_tpu():
+    """The ONNX->XLA ResNet-50 (bench_onnx's exact graph) lowers for
+    TPU — the converter's conv/BN/pool emission must pass TPU rules."""
+    import os
+    import sys
+
+    import jax.numpy as jnp
+
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    sys.path.insert(0, repo)
+    try:
+        from bench_onnx import _resnet50_proto
+    finally:
+        sys.path.pop(0)
+    from mmlspark_tpu.onnx import convert_model
+
+    rng = np.random.default_rng(0)
+    run = convert_model(_resnet50_proto(rng)).convert()
+    x = jnp.asarray(rng.normal(size=(4, 3, 224, 224)).astype(np.float32))
+    graph_in = "x"
+    txt = _lower_tpu(lambda xx: run({graph_in: xx}), x)
+    assert len(txt) > 1000
+
+
+def test_deeptext_train_step_lowers_for_tpu():
+    """One BERT-shaped text fine-tune step (fwd+bwd+optax update)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from mmlspark_tpu.dl.backbones import TextTransformer
+
+    module = TextTransformer(num_classes=2, vocab_size=2048, dim=128,
+                             heads=4, layers=2, max_len=64)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(1, 2048, size=(8, 64)).astype(np.int32))
+    y = jnp.asarray(rng.integers(0, 2, size=8).astype(np.int32))
+    params = module.init(jax.random.key(0), ids)
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(params)
+
+    def step(params, opt_state, ids, y):
+        def loss_fn(p):
+            logits = module.apply(p, ids)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    txt = _lower_tpu(step, params, opt_state, ids, y)
+    assert len(txt) > 1000
+
+
 def test_lowering_check_is_not_vacuous():
     import jax
     import jax.numpy as jnp
