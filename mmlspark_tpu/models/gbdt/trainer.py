@@ -999,12 +999,16 @@ def _get_step_fn(num_f, total_bins, cfg, k, n_valid, mode, mesh):
 
 
 def aot_lower_step(cfg: TrainConfig, n: int, num_f: int,
-                   platform: str = "tpu") -> str:
+                   platform: str = "tpu",
+                   rows_per_group: int = 0) -> str:
     """AOT-lower ONE fused boosting step for ``platform`` and return
     its StableHLO text — the exact program ``train()`` dispatches per
     iteration (bench.py's hot loop), checkable on any host. Used by
     tests/parallel/test_mosaic_lowering.py to gate TPU-day risk, and
-    handy on TPU day itself to inspect what XLA is given."""
+    handy on TPU day itself to inspect what XLA is given.
+
+    ``rows_per_group``: > 0 builds lambdarank group structure (uniform
+    query sizes) with the bucketed pairwise layout."""
     import jax
     import jax.numpy as jnp
 
@@ -1014,14 +1018,28 @@ def aot_lower_step(cfg: TrainConfig, n: int, num_f: int,
     step_fn = _get_step_fn(num_f, cfg.max_bin, cfg, k, 0, "serial", None)
     rng = np.random.default_rng(0)
     ones = jnp.ones(n, jnp.float32)
+    if cfg.objective == "lambdarank":
+        if rows_per_group <= 0:
+            raise ValueError("lambdarank lowering needs rows_per_group")
+        from mmlspark_tpu.models.gbdt.objectives import make_group_layout
+        gids = np.repeat(np.arange(n // rows_per_group + 1),
+                         rows_per_group)[:n]
+        rows, mask = make_group_layout(gids)
+        groups = jnp.asarray(gids)
+        group_layout = (jnp.asarray(rows), jnp.asarray(mask))
+        labels = jnp.asarray(rng.integers(0, 5, size=n).astype(np.float32))
+    else:
+        groups, group_layout = None, None
+        labels = jnp.asarray(
+            rng.integers(0, max(k, 2), size=n).astype(np.float32))
     data = {
         "binned": jnp.asarray(
             rng.integers(0, cfg.max_bin, size=(n, num_f)).astype(
                 np.uint8 if cfg.max_bin <= 256 else np.int32)),
-        "labels": jnp.asarray((rng.random(n) > 0.5).astype(np.float32)),
+        "labels": labels,
         "weights": ones,
-        "groups": None,
-        "group_layout": None,
+        "groups": groups,
+        "group_layout": group_layout,
         "row_valid": ones,
         "base": jnp.float32(0.0),
         "key": jax.random.key(0),

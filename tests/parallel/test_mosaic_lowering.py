@@ -310,6 +310,30 @@ def test_deeptext_train_step_lowers_for_tpu():
     assert len(txt) > 1000
 
 
+@pytest.mark.parametrize("objective,boosting,kw", [
+    ("lambdarank", "gbdt", dict(rows_per_group=128)),
+    ("multiclass", "gbdt", {}),
+    ("binary", "goss", {}),   # nanquantile (sort) must pass TPU rules
+    ("binary", "rf", {}),
+])
+def test_other_tracked_configs_lower_for_tpu(objective, boosting, kw):
+    from mmlspark_tpu.models.gbdt.trainer import (
+        TrainConfig,
+        aot_lower_step,
+    )
+
+    cfg_kw = dict(objective=objective, num_leaves=31, max_depth=5,
+                  max_bin=255, boosting_type=boosting)
+    if objective == "multiclass":
+        cfg_kw["num_class"] = 3
+    if boosting == "goss":
+        cfg_kw.update(top_rate=0.2, other_rate=0.1)
+    if boosting == "rf":
+        cfg_kw.update(bagging_fraction=0.8, bagging_freq=1)
+    txt = aot_lower_step(TrainConfig(**cfg_kw), n=4096, num_f=28, **kw)
+    assert len(txt) > 1000
+
+
 def test_lowering_check_is_not_vacuous():
     import jax
     import jax.numpy as jnp
