@@ -112,8 +112,11 @@ def main():
     enable_persistent_cache()
 
     rng = np.random.default_rng(0)
-    n, f = 2_000_000, 28  # HIGGS-shaped
-    num_trees = 100
+    # BENCH_ROWS: rehearsal/smoke override — the metric NAME changes
+    # with it so a small run can never masquerade as the tracked config
+    n = int(os.environ.get("BENCH_ROWS", 2_000_000))
+    f = 28  # HIGGS-shaped
+    num_trees = int(os.environ.get("BENCH_TREES", 100))
     x = rng.normal(size=(n, f)).astype(np.float32)
     logit = (x[:, 0] * 1.2 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
              + 0.3 * np.sin(x[:, 4] * 3))
@@ -150,6 +153,8 @@ def main():
               or jax.default_backend() == "cpu")
     intended_cpu = os.environ.get("BENCH_PLATFORM") == "cpu"
     suffix = "_cpu_fallback" if on_cpu and not intended_cpu else ""
+    if n != 2_000_000 or num_trees != 100:
+        suffix += f"_rows{n}_trees{num_trees}"
     print(json.dumps({
         "metric": "gbdt_fit_throughput_higgs28f_2M" + suffix,
         "value": round(row_trees_per_s, 3),

@@ -5,7 +5,20 @@
 # independently timeboxed so one hang can't eat the window.
 set -u
 cd "$(dirname "$0")/.."
-OUT=tools/tpu_results
+# TPU_DAY_REHEARSAL=1: full end-to-end rehearsal on the CPU backend at
+# small sizes — catches runbook/script bugs BEFORE they can eat a real
+# measurement window. Separate output dir + lock so a rehearsal can
+# never block (or be mistaken for) the real run; flash is skipped
+# (Mosaic kernels cannot execute on the CPU backend).
+REHEARSAL=${TPU_DAY_REHEARSAL:-0}
+if [ "$REHEARSAL" = "1" ]; then
+  OUT=tools/tpu_rehearsal
+  export BENCH_PLATFORM=cpu BENCH_ROWS=100000 BENCH_TREES=20
+  CPU="--cpu"
+else
+  OUT=tools/tpu_results
+  CPU=""
+fi
 mkdir -p "$OUT"
 # single-instance guard: the poller auto-launches this AND the notes
 # tell operators to run it by hand — never both at once
@@ -37,7 +50,11 @@ run() { # run <name> <timeout-s> <cmd...>
 }
 
 # 1. histogram formulation decision (includes the pallas variant)
-run hist 1800 python bench_hist.py
+if [ "$REHEARSAL" = "1" ]; then
+  run hist 1800 python bench_hist.py 100000 $CPU
+else
+  run hist 1800 python bench_hist.py
+fi
 # 2. flagship throughput as-is
 run bench_default 2400 python bench.py
 # 3. candidate configs: pallas kernel, histogram subtraction
@@ -46,13 +63,25 @@ MMLSPARK_TPU_HIST_SUB=1 run bench_sub 2400 python bench.py
 # 4. profile the best-so-far default for op-level attribution
 BENCH_PROFILE_DIR="$OUT/trace" run bench_profiled 2400 python bench.py
 # 5. the other north stars
-run onnx 1800 python bench_onnx.py 64
-run serving 1200 python tools/bench_serving.py 300
-run text 1800 python tools/bench_text.py 32
-run vw 1200 python tools/bench_vw.py
-run scoring 1800 python tools/bench_scoring.py
-run ranker 2400 python tools/bench_ranker.py
-# 6. flash kernel: first real compile + A/B (opt-in flag)
+if [ "$REHEARSAL" = "1" ]; then
+  run onnx 1800 python bench_onnx.py 8 $CPU
+  run serving 1200 python tools/bench_serving.py 50
+  run text 1800 python tools/bench_text.py 8 --small $CPU
+  run vw 1200 python tools/bench_vw.py 20000 $CPU
+  run scoring 1800 python tools/bench_scoring.py 100000 --small $CPU
+  run ranker 2400 python tools/bench_ranker.py --small $CPU
+else
+  run onnx 1800 python bench_onnx.py 64
+  run serving 1200 python tools/bench_serving.py 300
+  run text 1800 python tools/bench_text.py 32
+  run vw 1200 python tools/bench_vw.py
+  run scoring 1800 python tools/bench_scoring.py
+  run ranker 2400 python tools/bench_ranker.py
+fi
+# 6. flash kernel: first real compile + A/B (opt-in flag; Mosaic
+# kernels cannot execute on CPU, so rehearsal skips it)
+[ "$REHEARSAL" = "1" ] && { echo "[$(stamp)] flash skipped (rehearsal)" \
+  | tee -a "$OUT/log.txt"; } || \
 MMLSPARK_TPU_FLASH=1 run flash 900 python - <<'EOF'
 import time
 import jax
