@@ -147,13 +147,17 @@ def dense_group_index(group_ids: Array) -> Array:
 
 
 def make_group_layout(group_ids) -> tuple:
-    """HOST-side (numpy) padded group layout for the bucketed
-    lambdarank: returns ``(rows, mask)`` where ``rows`` is (G, S) int32
-    indices into the row arrays (pad slots point at index N — callers
-    append one sentinel row) and ``mask`` is (G, S) float32 1.0 on real
-    slots. G = number of groups, S = max group size: both static, so
-    the (G, S, S) pairwise work compiles to fixed shapes regardless of
-    how rows are distributed over queries."""
+    """HOST-side (numpy) padded group layouts for the bucketed
+    lambdarank: returns a tuple of ``(rows, mask)`` BUCKETS, each with
+    ``rows`` (G_b, S_b) int32 indices into the row arrays (pad slots
+    point at index N — callers append one sentinel row) and ``mask``
+    (G_b, S_b) float32 1.0 on real slots.
+
+    Groups are bucketed by next-power-of-two size so a skewed dataset
+    (MSLR queries span ~40..1200 docs) never pays max-size^2 pairwise
+    work for its small groups: per-bucket padding waste is bounded ~2x
+    and every bucket compiles to its own fixed shape (a handful of
+    shapes total, since sizes bucket logarithmically)."""
     import numpy as np
 
     gid = np.asarray(group_ids)
@@ -161,14 +165,29 @@ def make_group_layout(group_ids) -> tuple:
     inv = np.unique(gid, return_inverse=True)[1]
     order = np.argsort(inv, kind="stable")
     counts = np.bincount(inv)
-    g, s = len(counts), int(counts.max())
     starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
     pos_within = np.arange(n) - starts[inv[order]]
-    rows = np.full((g, s), n, dtype=np.int32)
-    mask = np.zeros((g, s), dtype=np.float32)
-    rows[inv[order], pos_within] = order.astype(np.int32)
-    mask[inv[order], pos_within] = 1.0
-    return rows, mask
+    # group -> size bucket (next power of two); dense index per bucket
+    bucket_of = np.maximum(
+        np.ceil(np.log2(np.maximum(counts, 1))), 0).astype(np.int64)
+    buckets = []
+    for b in np.unique(bucket_of):
+        gsel = np.nonzero(bucket_of == b)[0]       # group ids in bucket
+        s_b = int(counts[gsel].max())
+        g_b = len(gsel)
+        # dense position of each group within its bucket
+        local_of = np.full(len(counts), -1, np.int64)
+        local_of[gsel] = np.arange(g_b)
+        rows = np.full((g_b, s_b), n, dtype=np.int32)
+        mask = np.zeros((g_b, s_b), dtype=np.float32)
+        # rows whose group belongs to this bucket
+        in_b = bucket_of[inv[order]] == b
+        rr = local_of[inv[order][in_b]]
+        pp = pos_within[in_b]
+        rows[rr, pp] = order[in_b].astype(np.int32)
+        mask[rr, pp] = 1.0
+        buckets.append((rows, mask))
+    return tuple(buckets)
 
 
 def _ranks_within(x: Array, mask: Array) -> Array:
@@ -181,13 +200,30 @@ def _ranks_within(x: Array, mask: Array) -> Array:
 
 def _lambdarank_bucketed(preds, labels, group_layout, sigmoid_p,
                          truncation_level, label_gain):
-    """(G, S, S) within-group pairwise lambdas — compute and memory
-    scale with G*S^2 (rows x max-group-size), never with N^2."""
-    rows, mask = group_layout
-    pp = jnp.concatenate([preds, jnp.zeros(1, preds.dtype)])[rows]
-    ll = jnp.concatenate([labels, jnp.zeros(1, labels.dtype)])[rows]
+    """Within-group pairwise lambdas over size-bucketed (G_b, S_b, S_b)
+    tensors — compute and memory scale with sum_b G_b*S_b^2 (~rows x
+    own-group-size), never with N^2 or with the max group size."""
+    n = preds.shape[0]
+    grad = jnp.zeros(n + 1, preds.dtype)
+    hess = jnp.zeros(n + 1, preds.dtype)
+    preds_pad = jnp.concatenate([preds, jnp.zeros(1, preds.dtype)])
+    labels_pad = jnp.concatenate([labels, jnp.zeros(1, labels.dtype)])
+    for rows, mask in group_layout:
+        g_b, h_b = _lambdarank_one_bucket(
+            preds_pad, labels_pad, rows, mask, sigmoid_p,
+            truncation_level, label_gain)
+        flat_rows = rows.reshape(-1)
+        grad = grad.at[flat_rows].add(g_b.reshape(-1))
+        hess = hess.at[flat_rows].add(h_b.reshape(-1))
+    return grad[:n], jnp.maximum(hess[:n], 1e-9)
+
+
+def _lambdarank_one_bucket(preds_pad, labels_pad, rows, mask, sigmoid_p,
+                           truncation_level, label_gain):
+    pp = preds_pad[rows]
+    ll = labels_pad[rows]
     if label_gain is not None:
-        lg = jnp.asarray(label_gain, preds.dtype)
+        lg = jnp.asarray(label_gain, pp.dtype)
         gain = lg[jnp.clip(ll.astype(jnp.int32), 0, lg.shape[0] - 1)]
     else:
         gain = 2.0 ** ll - 1.0
@@ -215,13 +251,7 @@ def _lambdarank_bucketed(preds, labels, group_layout, sigmoid_p,
                   0.0)
     grad_gs = (jnp.sum(lam, axis=2) - jnp.sum(lam, axis=1)) * mask
     hess_gs = (jnp.sum(h, axis=2) + jnp.sum(h, axis=1)) * mask
-    n = preds.shape[0]
-    flat_rows = rows.reshape(-1)
-    grad = jnp.zeros(n + 1, preds.dtype).at[flat_rows].add(
-        grad_gs.reshape(-1))[:n]
-    hess = jnp.zeros(n + 1, preds.dtype).at[flat_rows].add(
-        hess_gs.reshape(-1))[:n]
-    return grad, jnp.maximum(hess, 1e-9)
+    return grad_gs, hess_gs
 
 
 def lambdarank(preds: Array, labels: Array, weights=None,

@@ -1024,9 +1024,9 @@ def aot_lower_step(cfg: TrainConfig, n: int, num_f: int,
         from mmlspark_tpu.models.gbdt.objectives import make_group_layout
         gids = np.repeat(np.arange(n // rows_per_group + 1),
                          rows_per_group)[:n]
-        rows, mask = make_group_layout(gids)
         groups = jnp.asarray(gids)
-        group_layout = (jnp.asarray(rows), jnp.asarray(mask))
+        group_layout = tuple((jnp.asarray(r), jnp.asarray(m))
+                             for r, m in make_group_layout(gids))
         labels = jnp.asarray(rng.integers(0, 5, size=n).astype(np.float32))
     else:
         groups, group_layout = None, None
@@ -1205,8 +1205,9 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
         # host array: the lambdarank pairwise work runs per group,
         # never as an (N, N) matrix
         from mmlspark_tpu.models.gbdt.objectives import make_group_layout
-        _rows, _mask = make_group_layout(np.asarray(group_ids))
-        group_layout = (jnp.asarray(_rows), jnp.asarray(_mask))
+        group_layout = tuple(
+            (jnp.asarray(r), jnp.asarray(m))
+            for r, m in make_group_layout(np.asarray(group_ids)))
     else:
         group_layout = None
 
