@@ -22,14 +22,20 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def make_mslr_shaped(n_queries: int, f: int = 136, seed: int = 0):
+def make_mslr_shaped(n_queries: int, f: int = 136, seed: int = 0,
+                     skewed: bool = False):
     """Graded-relevance synthetic with MSLR-like shape: variable group
-    sizes (80-180 docs), relevance 0-4 from a hidden utility quantized
+    sizes (80-180 docs; ``skewed`` draws log-uniform 8..1200 like real
+    MSLR's long tail), relevance 0-4 from a hidden utility quantized
     per-query (so every query has a mix of grades)."""
     import numpy as np
 
     rng = np.random.default_rng(seed)
-    sizes = rng.integers(80, 181, size=n_queries)
+    if skewed:
+        sizes = np.exp(rng.uniform(np.log(8), np.log(1200),
+                                   size=n_queries)).astype(np.int64)
+    else:
+        sizes = rng.integers(80, 181, size=n_queries)
     n = int(sizes.sum())
     x = rng.normal(size=(n, f)).astype(np.float64)
     w_true = rng.normal(size=f) * (rng.random(f) < 0.15)  # sparse signal
@@ -50,6 +56,7 @@ def main():
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     n_queries = int(args[0]) if args else 2000
     trees = 100
+    skewed = "--skewed" in sys.argv
     if "--small" in sys.argv:
         n_queries, trees = 100, 10
     if "--cpu" in sys.argv:
@@ -67,7 +74,7 @@ def main():
     from mmlspark_tpu.ops.binning import BinMapper
 
     backend = jax.default_backend()
-    x, labels, group_ids = make_mslr_shaped(n_queries)
+    x, labels, group_ids = make_mslr_shaped(n_queries, skewed=skewed)
     n = x.shape[0]
     max_bin = 255
     mapper = BinMapper.fit(x, max_bin=max_bin)
@@ -92,7 +99,7 @@ def main():
                              group_ids=jnp.asarray(group_ids)))
 
     print(json.dumps({
-        "metric": "lambdarank_fit",
+        "metric": "lambdarank_fit" + ("_skewed" if skewed else ""),
         "value": round(mrow_trees, 4),
         "unit": "Mrow-trees/s",
         "backend": backend,
