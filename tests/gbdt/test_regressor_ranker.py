@@ -103,6 +103,38 @@ def make_ranking(num_groups=30, per_group=12, seed=5):
     return DataFrame({"features": X, "label": rel, "query": group.astype(np.int64)})
 
 
+def test_ndcg_metric_matches_sklearn_oracle(rng):
+    """The in-engine ndcg@k metric against sklearn.metrics.ndcg_score
+    (an independent oracle): with linear label_gain both use
+    gain=relevance and the log2 discount, so per-query values must
+    agree to float tolerance — across skewed group sizes and ties."""
+    import jax.numpy as jnp
+    from sklearn.metrics import ndcg_score
+
+    from mmlspark_tpu.models.gbdt.metrics import ndcg_at
+
+    sizes = [3, 7, 12, 40, 5, 21, 9, 64]
+    gid = np.repeat(np.arange(len(sizes)), sizes)
+    n = len(gid)
+    scores = rng.normal(size=n)
+    labels = rng.integers(0, 5, size=n).astype(np.float64)
+    labels[: sizes[0]] = 2.0  # an all-tied group
+
+    k = 10
+    ours = float(ndcg_at(k, label_gain=(0.0, 1.0, 2.0, 3.0, 4.0))(
+        jnp.asarray(scores), jnp.asarray(labels),
+        group_ids=jnp.asarray(gid)))
+    per_query = []
+    start = 0
+    for qs in sizes:
+        y = labels[start:start + qs][None, :]
+        s = scores[start:start + qs][None, :]
+        per_query.append(ndcg_score(y, s, k=k) if y.max() > 0 else 1.0)
+        start += qs
+    assert abs(ours - float(np.mean(per_query))) < 1e-6, \
+        (ours, float(np.mean(per_query)))
+
+
 def ndcg_at_k(scores, labels, groups, k=5):
     total, count = 0.0, 0
     for g in np.unique(groups):
