@@ -18,13 +18,22 @@ import sys
 HERE = os.path.dirname(__file__)
 
 
-def test_two_process_dp_training_matches_single():
+import pytest
+
+
+@pytest.mark.parametrize("num_procs,devices_per_process", [
+    (2, 4),   # the standard rig
+    (4, 2),   # more ranks through the rendezvous, smaller shards
+])
+def test_multi_process_dp_training_matches_single(num_procs,
+                                                  devices_per_process):
     sys.path.insert(0, HERE)
     try:
         from mp_worker import run_and_check
     finally:
         sys.path.pop(0)
-    run_and_check(num_procs=2, devices_per_process=4)
+    run_and_check(num_procs=num_procs,
+                  devices_per_process=devices_per_process)
 
 
 def test_dead_rank_fails_fast(tmp_path):
