@@ -216,7 +216,8 @@ def _objective_kwargs(cfg: TrainConfig) -> Dict[str, Any]:
 # ---------------------------------------------------------------------------
 
 def _level_histogram(binned, grad, hess, live, local, width, f, b,
-                     in_shard_map: bool = False):
+                     in_shard_map: bool = False,
+                     allow_pallas: bool = True):
     """Per-level histogram: (N, F) bins + per-row stats ->
     (width, F, B, 3) grad/hess/count sums.
 
@@ -237,7 +238,7 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
         pallas_level_histogram,
     )
 
-    if pallas_histogram_enabled() and b <= 256:
+    if pallas_histogram_enabled() and allow_pallas and b <= 256:
         # opt-in Pallas kernel (hist_pallas.py; bench_hist.py measures
         # it against the XLA formulations below on each backend). Safe
         # per-shard under shard_map too: the kernel only ever sees this
@@ -272,7 +273,7 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
 
 
 def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
-                    subtract: bool = False):
+                    subtract: bool = False, allow_pallas: bool = True):
     """Compile-once tree builder: (binned, grad, hess, valid, feat_mask,
     remaining_leaves) -> (split_feature, threshold_bin, node_value, count,
     decision_type, bin_go_left).
@@ -408,7 +409,8 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
                     [local, jnp.zeros(1, local.dtype)])
                 hist_small = _level_histogram(
                     binned_pad[idx], grad_pad[idx], hess_pad[idx],
-                    live_pad[idx], local_pad[idx], width, f, b)
+                    live_pad[idx], local_pad[idx], width, f, b,
+                    allow_pallas=allow_pallas)
                 kids = jnp.arange(width)
                 par_idx = kids // 2
                 is_small = (kids % 2) == prev_ss[par_idx]
@@ -424,7 +426,8 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
                 hist = hist.at[..., 2].max(0.0)
             else:
                 hist = _level_histogram(binned, grad, hess, live, local,
-                                        width, f, b)
+                                        width, f, b,
+                                        allow_pallas=allow_pallas)
             if subtract:
                 prev_hist = hist
 
@@ -757,8 +760,14 @@ def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
                                                  mesh),
                 total_bins)
         else:
+            # serial builder under a mesh = GSPMD auto-partitioning,
+            # which cannot partition Mosaic kernels ("Please wrap the
+            # call in a shard_map") — the Pallas histogram is only
+            # selectable single-program here; the distributed modes
+            # above run it per-shard inside their explicit shard_maps
             fn = make_build_tree(num_f, total_bins, cfg,
-                                 subtract=subtract)
+                                 subtract=subtract,
+                                 allow_pallas=mesh is None)
         return jax.jit(fn)
 
     if mode in ("voting", "feature") and cfg.categorical_features:

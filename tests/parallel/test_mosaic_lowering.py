@@ -334,6 +334,47 @@ def test_other_tracked_configs_lower_for_tpu(objective, boosting, kw):
     assert len(txt) > 1000
 
 
+def test_gspmd_dp_falls_back_to_xla_histogram(monkeypatch):
+    """GSPMD cannot auto-partition Mosaic kernels ('Please wrap the
+    call in a shard_map'): the serial builder under a mesh must bypass
+    the Pallas kernel even when the flag is on, or dp training with
+    MMLSPARK_TPU_PALLAS_HIST=1 would CRASH at TPU compile. Lowering
+    over row-sharded inputs must succeed WITHOUT a tpu_custom_call."""
+    monkeypatch.setenv("MMLSPARK_TPU_PALLAS_HIST", "1")
+    monkeypatch.setenv("MMLSPARK_TPU_PALLAS_FORCE_COMPILE", "1")
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mmlspark_tpu.models.gbdt.trainer import (
+        TrainConfig,
+        _get_builder,
+        _loop_only_normalized,
+    )
+    from mmlspark_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    mesh = create_mesh(MeshConfig(dp=8))
+    cfg = _loop_only_normalized(TrainConfig(
+        objective="binary", num_leaves=15, max_depth=4, max_bin=64))
+    fn = _get_builder(8, 64, cfg, "serial", mesh)
+    n, f = 1024, 8
+    rng = np.random.default_rng(0)
+    row = NamedSharding(mesh, P("dp"))
+    row2 = NamedSharding(mesh, P("dp", None))
+    args = (jax.device_put(
+                rng.integers(0, 64, size=(n, f)).astype(np.uint8), row2),
+            jax.device_put(rng.normal(size=n).astype(np.float32), row),
+            jax.device_put(
+                rng.uniform(0.1, 1, size=n).astype(np.float32), row),
+            jax.device_put(np.ones(n, np.float32), row),
+            jnp.ones(f, jnp.float32),
+            jnp.int32(15))
+    txt = fn.trace(*args).lower(lowering_platforms=("tpu",)).as_text()
+    assert "tpu_custom_call" not in txt  # XLA formulation selected
+    assert len(txt) > 1000
+
+
 def test_lowering_check_is_not_vacuous():
     import jax
     import jax.numpy as jnp
