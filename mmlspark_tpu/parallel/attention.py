@@ -87,10 +87,17 @@ def blockwise_attention(q, k, v, block_size: int = 512,
             q_offset=0, k_offset=blk_i * block, causal=causal, scale=scale)
         return (out, row_max, row_sum, blk_i + 1), None
 
-    init = (jnp.zeros_like(q),
-            jnp.full((b, h, n), _NEG_INF, q.dtype),
-            jnp.zeros((b, h, n), q.dtype),
-            jnp.asarray(0))
+    stats0 = (jnp.full((b, h, n), _NEG_INF, q.dtype),
+              jnp.zeros((b, h, n), q.dtype))
+    # inside a shard_map (e.g. the Ulysses inner attention) the inputs
+    # vary over the sp axis, so the freshly-created accumulators must be
+    # promoted to the same varying type or the scan carry mismatches
+    vma = frozenset()
+    for operand in (q, k, v):
+        vma = vma | getattr(jax.typeof(operand), "vma", frozenset())
+    if vma:
+        stats0 = jax.lax.pcast(stats0, tuple(sorted(vma)), to="varying")
+    init = (jnp.zeros_like(q), *stats0, jnp.asarray(0))
     (out, row_max, row_sum, _), _ = jax.lax.scan(
         step, init, (k_blocks, v_blocks))
     return out / jnp.maximum(row_sum, 1e-30).transpose(0, 2, 1)[..., None]
@@ -195,13 +202,12 @@ def ulysses_attention(q, k, v, mesh, causal: bool = False,
                                       concat_axis=2, tiled=True)
 
         qh, kh, vh = seq_to_heads(qc), seq_to_heads(kc), seq_to_heads(vc)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
-        if causal:
-            pos = jnp.arange(n)
-            mask = pos[:, None] >= pos[None, :]
-            scores = jnp.where(mask[None, None, :, :], scores, _NEG_INF)
-        p = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+        # memory-efficient inner attention: the head-group sees the FULL
+        # sequence here, so a dense (n, n) score matrix would defeat the
+        # point of sequence parallelism at long context — fused_attention
+        # streams KV blocks (XLA blockwise; the Pallas flash kernel when
+        # enabled on TPU, which is legal per-shard inside this shard_map)
+        out = fused_attention(qh, kh, vh, causal=causal)
         return heads_to_seq(out)
 
     return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
