@@ -334,6 +334,29 @@ def test_other_tracked_configs_lower_for_tpu(objective, boosting, kw):
     assert len(txt) > 1000
 
 
+def test_ulysses_never_materializes_dense_scores():
+    """Ulysses' inner attention must stream KV blocks: the lowered
+    program at a long sequence may not contain an (n, n) score tensor
+    (which would be quadratic memory — the thing sequence parallelism
+    exists to avoid)."""
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.parallel.attention import ulysses_attention
+    from mmlspark_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    sp_mesh = create_mesh(MeshConfig(dp=1, sp=8))
+    n = 8192
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(
+        rng.normal(size=(1, n, 8, 16)).astype(np.float32))
+        for _ in range(3))
+    txt = _lower_tpu(
+        lambda a, b, c: ulysses_attention(a, b, c, sp_mesh, causal=True),
+        q, k, v)
+    assert f"{n}x{n}" not in txt and f"{n},{n}" not in txt, \
+        "dense (n, n) scores materialized in the lowered program"
+
+
 def test_gspmd_dp_falls_back_to_xla_histogram(monkeypatch):
     """GSPMD cannot auto-partition Mosaic kernels ('Please wrap the
     call in a shard_map'): the serial builder under a mesh must bypass
