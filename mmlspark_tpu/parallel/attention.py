@@ -63,6 +63,50 @@ def _block_attend(q, k, v, out, row_max, row_sum, q_offset, k_offset,
     return new_out, new_max, new_sum
 
 
+def _streamed_attend(q, k, v, out, row_max, row_sum, q_offset, k_offset,
+                     causal: bool, scale: float, block_size: int = 512):
+    """Online-softmax accumulation over ``k``/``v`` in sub-blocks, so
+    the materialized score tile is (nq, block_size) instead of
+    (nq, nk) — ring attention's per-rotation attend stays linear in
+    the rotated chunk length at any sequence scale."""
+    import jax
+    import jax.numpy as jnp
+
+    nk = k.shape[1]
+    block = min(block_size, nk)
+    if nk % block:
+        # fall back to one tile when the chunk doesn't split evenly
+        return _block_attend(q, k, v, out, row_max, row_sum,
+                             q_offset, k_offset, causal, scale)
+    n_blocks = nk // block
+    if n_blocks == 1:
+        return _block_attend(q, k, v, out, row_max, row_sum,
+                             q_offset, k_offset, causal, scale)
+    b = k.shape[0]
+    kb = k.reshape(b, n_blocks, block, *k.shape[2:]).transpose(
+        1, 0, 2, 3, 4)
+    vb = v.reshape(b, n_blocks, block, *v.shape[2:]).transpose(
+        1, 0, 2, 3, 4)
+
+    def step(carry, blk):
+        out, row_max, row_sum, i = carry
+        kk, vv = blk
+        out, row_max, row_sum = _block_attend(
+            q, kk, vv, out, row_max, row_sum, q_offset,
+            k_offset + i * block, causal, scale)
+        return (out, row_max, row_sum, i + 1), None
+
+    i0 = jnp.asarray(0)
+    vma = frozenset()
+    for operand in (q, k, v, out, row_max, row_sum):
+        vma = vma | getattr(jax.typeof(operand), "vma", frozenset())
+    if vma:
+        i0 = jax.lax.pcast(i0, tuple(sorted(vma)), to="varying")
+    (out, row_max, row_sum, _), _ = jax.lax.scan(
+        step, (out, row_max, row_sum, i0), (kb, vb))
+    return out, row_max, row_sum
+
+
 def blockwise_attention(q, k, v, block_size: int = 512,
                         causal: bool = False):
     """Memory-efficient attention via lax.scan over KV blocks."""
@@ -149,7 +193,7 @@ def ring_attention(q, k, v, mesh, causal: bool = False,
             out, row_max, row_sum, kb, vb = carry
             # the KV block currently held started at device (idx - i)
             src = (idx - i) % sp
-            out, row_max, row_sum = _block_attend(
+            out, row_max, row_sum = _streamed_attend(
                 qc, kb, vb, out, row_max, row_sum,
                 q_offset=q_off, k_offset=src * chunk,
                 causal=causal, scale=scale)

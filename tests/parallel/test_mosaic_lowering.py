@@ -357,6 +357,28 @@ def test_ulysses_never_materializes_dense_scores():
         "dense (n, n) scores materialized in the lowered program"
 
 
+def test_ring_streams_rotated_chunks():
+    """Ring attention's per-rotation attend must stream the rotated KV
+    chunk in sub-blocks: at n=8192 over sp=8 the chunk is 1024, so a
+    non-streamed attend would materialize (1024, 1024) score tiles."""
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.parallel.attention import ring_attention
+    from mmlspark_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    sp_mesh = create_mesh(MeshConfig(dp=1, sp=8))
+    n = 8192
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(
+        rng.normal(size=(1, n, 8, 16)).astype(np.float32))
+        for _ in range(3))
+    txt = _lower_tpu(
+        lambda a, b, c: ring_attention(a, b, c, sp_mesh, causal=True),
+        q, k, v)
+    assert "1024x1024" not in txt and f"{n}x{n}" not in txt, \
+        "chunk-squared score tile materialized in ring attention"
+
+
 def test_gspmd_dp_falls_back_to_xla_histogram(monkeypatch):
     """GSPMD cannot auto-partition Mosaic kernels ('Please wrap the
     call in a shard_map'): the serial builder under a mesh must bypass
