@@ -116,9 +116,16 @@ def blockwise_attention(q, k, v, block_size: int = 512,
     b, n, h, d = q.shape
     nk = k.shape[1]
     scale = 1.0 / (d ** 0.5)
+    # largest divisor of nk that fits the requested block: any kv
+    # length streams (the scan needs equal blocks; a 704-long sequence
+    # gets 352-wide blocks rather than a ValueError). Awkward lengths
+    # whose divisors are all tiny (primes) take one dense tile instead
+    # of degenerating into a column-at-a-time scan.
     block = min(block_size, nk)
-    if nk % block:
-        raise ValueError(f"kv length {nk} not divisible by block {block}")
+    while nk % block:
+        block -= 1
+    if block < min(block_size, nk) // 4:
+        block = nk
     n_blocks = nk // block
     k_blocks = k.reshape(b, n_blocks, block, h, d).transpose(1, 0, 2, 3, 4)
     v_blocks = v.reshape(b, n_blocks, block, h, d).transpose(1, 0, 2, 3, 4)

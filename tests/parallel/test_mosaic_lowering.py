@@ -357,6 +357,41 @@ def test_ulysses_never_materializes_dense_scores():
         "dense (n, n) scores materialized in the lowered program"
 
 
+def test_attention_awkward_lengths():
+    """Non-power-of-two / non-block-divisible sequence lengths must
+    work through every attention path (the old dense Ulysses inner
+    accepted any length; the streaming one must too)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.parallel.attention import (
+        blockwise_attention,
+        dense_attention,
+        ulysses_attention,
+    )
+    from mmlspark_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    rng = np.random.default_rng(0)
+    for n in (704, 1021):  # 704 = 2^6*11; 1021 prime
+        q, k, v = (jnp.asarray(
+            rng.normal(size=(1, n, 8, 16)).astype(np.float32))
+            for _ in range(3))
+        want = dense_attention(q, k, v, causal=True)
+        got = blockwise_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+    sp_mesh = create_mesh(MeshConfig(dp=1, sp=8))
+    n = 704  # divisible by sp=8, not by 512
+    q, k, v = (jnp.asarray(
+        rng.normal(size=(1, n, 8, 16)).astype(np.float32))
+        for _ in range(3))
+    want = dense_attention(q, k, v, causal=True)
+    got = jax.jit(lambda a, b, c: ulysses_attention(
+        a, b, c, sp_mesh, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
+
+
 def test_ring_streams_rotated_chunks():
     """Ring attention's per-rotation attend must stream the rotated KV
     chunk in sub-blocks: at n=8192 over sp=8 the chunk is 1024, so a
