@@ -169,6 +169,35 @@ def test_pallas_under_shard_map_modes(monkeypatch, tree_learner, mesh_cfg):
     np.testing.assert_allclose(p0, p1, rtol=1e-4, atol=1e-4)
 
 
+def test_dp_serial_with_flag_bypasses_pallas(monkeypatch, rng):
+    """The serial builder under a mesh runs via GSPMD, which cannot
+    partition Mosaic kernels — with MMLSPARK_TPU_PALLAS_HIST=1 it must
+    silently take the XLA formulation (identical trees to flag-off),
+    not crash at TPU compile (pinned at lowering level in
+    test_mosaic_lowering.py; this is the execution-level twin)."""
+    from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
+    from mmlspark_tpu.ops.binning import BinMapper
+    from mmlspark_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    mesh = create_mesh(MeshConfig(dp=8))
+    x = rng.normal(size=(512, 6))
+    y = (x[:, 0] - 0.5 * x[:, 1] > 0).astype(np.float64)
+    mapper = BinMapper.fit(x, max_bin=32)
+    binned = mapper.transform(x)
+    bu = mapper.bin_upper_values(32)
+    cfg = TrainConfig(objective="binary", num_iterations=3, num_leaves=7,
+                      max_depth=3, min_data_in_leaf=5, max_bin=32)
+    base = train(binned, y, cfg, bin_upper=bu, mesh=mesh)
+    monkeypatch.setenv("MMLSPARK_TPU_PALLAS_HIST", "1")
+    flagged = train(binned, y, cfg, bin_upper=bu, mesh=mesh)
+    np.testing.assert_array_equal(base.booster.split_feature,
+                                  flagged.booster.split_feature)
+    np.testing.assert_array_equal(base.booster.threshold_bin,
+                                  flagged.booster.threshold_bin)
+    np.testing.assert_array_equal(base.booster.node_value,
+                                  flagged.booster.node_value)
+
+
 def test_histogram_subtraction_matches_full(monkeypatch):
     """MMLSPARK_TPU_HIST_SUB=1 derives sibling histograms by
     subtraction (LightGBM's trick); models must match the full
