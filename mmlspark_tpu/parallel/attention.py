@@ -73,11 +73,14 @@ def _streamed_attend(q, k, v, out, row_max, row_sum, q_offset, k_offset,
     import jax.numpy as jnp
 
     nk = k.shape[1]
+    # divisor-fit block, exactly as blockwise_attention: awkward chunk
+    # lengths stream at the largest fitting divisor; prime-ish lengths
+    # take one dense tile rather than a column-at-a-time scan
     block = min(block_size, nk)
-    if nk % block:
-        # fall back to one tile when the chunk doesn't split evenly
-        return _block_attend(q, k, v, out, row_max, row_sum,
-                             q_offset, k_offset, causal, scale)
+    while nk % block:
+        block -= 1
+    if block < min(block_size, nk) // 4:
+        block = nk
     n_blocks = nk // block
     if n_blocks == 1:
         return _block_attend(q, k, v, out, row_max, row_sum,
