@@ -5,9 +5,13 @@ is the flagship trainer's hot op (SURVEY.md §2.7 row 1). This script
 measures the candidate XLA formulations on the current backend so the
 trainer can adopt the winner per hardware:
 
-  A. stacked   — one segment_sum over (N*F, 3) rows (trainer default)
+  A. stacked   — one segment_sum over (N*F, 3) rows (reachable only
+                 via MMLSPARK_TPU_HIST_FORMULATION=fused; fails to
+                 compile on the axon TPU stack)
   B. separate  — three scalar segment_sums sharing the index vector
+                 (trainer default under shard_map on TPU)
   C. per-feat  — fori_loop over features, (N, 3) segments each
+                 (trainer default outside shard_map)
   D. scatter   — zeros.at[idx].add on the flat (width*F*B, 3) table
 
 Run: python bench_hist.py [N] [--cpu] (default 2_000_000). Prints one
@@ -92,10 +96,15 @@ def main():
         return pallas_level_histogram(binned, grad, hess, live, local,
                                       width, f, b)
 
-    variants = {"stacked": variant_stacked, "separate": variant_separate,
+    # Order = measurement priority: the 2026-07-31 TPU window died
+    # mid-run, so the most decision-relevant variants go first (pallas
+    # had never been Mosaic-compiled; scatter hung in remote compile
+    # and goes dead last).
+    variants = {"pallas": variant_pallas,
                 "per_feature": variant_per_feature,
-                "scatter": variant_scatter,
-                "pallas": variant_pallas}
+                "separate": variant_separate,
+                "stacked": variant_stacked,
+                "scatter": variant_scatter}
     if jax.default_backend() != "tpu":
         # interpret-mode pallas at bench scale is not a measurement
         variants.pop("pallas")
@@ -111,18 +120,21 @@ def main():
             jax.block_until_ready(out)
             dt = (time.perf_counter() - t0) / reps
         except Exception as e:  # a variant may not lower on a backend
-            print(json.dumps({"variant": name, "error": str(e)[:120]}))
+            print(json.dumps({"variant": name, "error": str(e)[:400]}),
+                  flush=True)
             continue
         results[name] = dt
         print(json.dumps({
             "variant": name, "seconds_per_level": round(dt, 5),
             "rows_per_s_M": round(n / dt / 1e6, 1),
-            "backend": jax.default_backend()}))
+            "backend": jax.default_backend()}), flush=True)
     if results:
         best = min(results, key=results.get)
-        print(json.dumps({"best": best,
-                          "speedup_vs_stacked": round(
-                              results.get("stacked", 0) / results[best], 2)}))
+        stacked = results.get("stacked")
+        print(json.dumps({
+            "best": best,
+            "speedup_vs_stacked": (round(stacked / results[best], 2)
+                                   if stacked else None)}), flush=True)
 
 
 if __name__ == "__main__":

@@ -225,3 +225,29 @@ def test_histogram_subtraction_matches_full(monkeypatch):
     # identical structure on well-separated early splits
     assert (base.booster.split_feature[:, 0]
             == sub.booster.split_feature[:, 0]).all()
+
+
+@pytest.mark.parametrize("forced", ["per_feature", "separate", "fused"])
+def test_formulation_override_agrees(forced, monkeypatch):
+    """MMLSPARK_TPU_HIST_FORMULATION selects each XLA formulation; all
+    must produce identical histograms (the separate branch is the
+    production default for shard_map on TPU and is otherwise never
+    selected on CPU, so this is its coverage)."""
+    binned, grad, hess, live, local = _case(3000, 5, 31, 8, seed=3)
+    ref = np.asarray(_level_histogram(binned, grad, hess, live, local,
+                                      8, 5, 31, allow_pallas=False))
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_FORMULATION", forced)
+    out = np.asarray(_level_histogram(binned, grad, hess, live, local,
+                                      8, 5, 31, allow_pallas=False))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_formulation_override_bogus_value_ignored(monkeypatch):
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_FORMULATION", "perfeature")
+    binned, grad, hess, live, local = _case(1000, 3, 15, 4, seed=4)
+    ref = np.asarray(_level_histogram(binned, grad, hess, live, local,
+                                      4, 3, 15, allow_pallas=False))
+    monkeypatch.delenv("MMLSPARK_TPU_HIST_FORMULATION")
+    out = np.asarray(_level_histogram(binned, grad, hess, live, local,
+                                      4, 3, 15, allow_pallas=False))
+    np.testing.assert_array_equal(ref, out)

@@ -49,19 +49,31 @@ run() { # run <name> <timeout-s> <cmd...>
   fi
 }
 
-# 1. histogram formulation decision (includes the pallas variant)
+# 0. smoke at reduced shape: an end-to-end TPU number (auto-suffixed
+#    metric) within minutes of window-up, validating the full train
+#    step compiles on the remote helper before the big boxes run. The
+#    2026-07-31 window lasted ~15 min total — first artifact fast.
+if [ "$REHEARSAL" = "1" ]; then SMOKE_ROWS=50000 SMOKE_TREES=5
+else SMOKE_ROWS=500000 SMOKE_TREES=20; fi
+run bench_smoke 900 env BENCH_ROWS=$SMOKE_ROWS BENCH_TREES=$SMOKE_TREES python bench.py
+MMLSPARK_TPU_PALLAS_HIST=1 \
+  run bench_pallas_smoke 900 env BENCH_ROWS=$SMOKE_ROWS BENCH_TREES=$SMOKE_TREES python bench.py
+# 1. flagship throughput as-is (per_feature formulation default since
+#    the 2026-07-31 window: fused failed remote compile, per_feature
+#    measured 3.2x separate) — the round's single most valuable number
+run bench_default 1800 python bench.py
+# 2. candidate configs: pallas kernel, histogram subtraction, fused A/B
+MMLSPARK_TPU_PALLAS_HIST=1 run bench_pallas 1800 python bench.py
+MMLSPARK_TPU_HIST_SUB=1 run bench_sub 1500 python bench.py
+MMLSPARK_TPU_HIST_FORMULATION=fused run bench_fused 1200 python bench.py
+# 3. histogram formulation microbench (pallas variant first)
 if [ "$REHEARSAL" = "1" ]; then
-  run hist 1800 python bench_hist.py 100000 $CPU
+  run hist 1500 python bench_hist.py 100000 $CPU
 else
-  run hist 1800 python bench_hist.py
+  run hist 1500 python bench_hist.py
 fi
-# 2. flagship throughput as-is
-run bench_default 2400 python bench.py
-# 3. candidate configs: pallas kernel, histogram subtraction
-MMLSPARK_TPU_PALLAS_HIST=1 run bench_pallas 2400 python bench.py
-MMLSPARK_TPU_HIST_SUB=1 run bench_sub 2400 python bench.py
 # 4. profile the best-so-far default for op-level attribution
-BENCH_PROFILE_DIR="$OUT/trace" run bench_profiled 2400 python bench.py
+BENCH_PROFILE_DIR="$OUT/trace" run bench_profiled 1500 python bench.py
 # 5. the other north stars
 if [ "$REHEARSAL" = "1" ]; then
   run onnx 1800 python bench_onnx.py 8 $CPU
