@@ -99,15 +99,30 @@ def main():
     # Order = measurement priority: the 2026-07-31 TPU window died
     # mid-run, so the most decision-relevant variants go first (pallas
     # had never been Mosaic-compiled; scatter hung in remote compile
-    # and goes dead last).
+    # and goes dead last). A single hung remote compile starves every
+    # later variant in the same process, so tpu_day.sh runs subsets in
+    # separately-timeboxed steps via --only=name1,name2.
     variants = {"pallas": variant_pallas,
                 "per_feature": variant_per_feature,
                 "separate": variant_separate,
                 "stacked": variant_stacked,
                 "scatter": variant_scatter}
-    if jax.default_backend() != "tpu":
+    only = [a.split("=", 1)[1] for a in sys.argv[1:]
+            if a.startswith("--only=")]
+    if only:
+        requested = [s for s in only[0].split(",") if s]
+        unknown = [s for s in requested if s not in variants]
+        if unknown:
+            raise SystemExit(f"unknown --only variants: {unknown}; "
+                             f"have {list(variants)}")
+        variants = {k: variants[k] for k in requested}
+    if jax.default_backend() != "tpu" and "pallas" in variants:
         # interpret-mode pallas at bench scale is not a measurement
         variants.pop("pallas")
+    if not variants:
+        print(json.dumps({"note": "no runnable variants on this "
+                          "backend for the requested --only set"}))
+        return
     results = {}
     for name, fn in variants.items():
         jitted = jax.jit(fn)

@@ -215,6 +215,9 @@ def _objective_kwargs(cfg: TrainConfig) -> Dict[str, Any]:
 # Tree building (device side)
 # ---------------------------------------------------------------------------
 
+_WARNED_BAD_FORMULATION = False
+
+
 def _level_histogram(binned, grad, hess, live, local, width, f, b,
                      in_shard_map: bool = False,
                      allow_pallas: bool = True):
@@ -252,7 +255,17 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
                                       width, f, b)
 
     forced = os.environ.get("MMLSPARK_TPU_HIST_FORMULATION", "").strip()
-    if forced not in ("per_feature", "separate", "fused"):
+    if forced and forced not in ("per_feature", "separate", "fused"):
+        # a mistyped value silently running the default would mislabel
+        # an A/B measurement — warn loudly (once per process)
+        global _WARNED_BAD_FORMULATION
+        if not _WARNED_BAD_FORMULATION:
+            _WARNED_BAD_FORMULATION = True
+            import warnings
+            warnings.warn(
+                f"MMLSPARK_TPU_HIST_FORMULATION={forced!r} is not one "
+                "of per_feature|separate|fused; using the default "
+                "formulation instead", stacklevel=2)
         forced = ""
     # Resolve which formulation runs. per_feature's fori_loop carry is
     # not shard_map-safe, so under shard_map a per_feature request

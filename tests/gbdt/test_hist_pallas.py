@@ -242,11 +242,16 @@ def test_formulation_override_agrees(forced, monkeypatch):
     np.testing.assert_array_equal(out, ref)
 
 
-def test_formulation_override_bogus_value_ignored(monkeypatch):
+def test_formulation_override_bogus_value_warns_and_uses_default(
+        monkeypatch):
+    from mmlspark_tpu.models.gbdt import trainer as trainer_mod
+    monkeypatch.setattr(trainer_mod, "_WARNED_BAD_FORMULATION", False)
     monkeypatch.setenv("MMLSPARK_TPU_HIST_FORMULATION", "perfeature")
     binned, grad, hess, live, local = _case(1000, 3, 15, 4, seed=4)
-    ref = np.asarray(_level_histogram(binned, grad, hess, live, local,
-                                      4, 3, 15, allow_pallas=False))
+    with pytest.warns(UserWarning, match="perfeature"):
+        ref = np.asarray(_level_histogram(
+            binned, grad, hess, live, local, 4, 3, 15,
+            allow_pallas=False))
     monkeypatch.delenv("MMLSPARK_TPU_HIST_FORMULATION")
     out = np.asarray(_level_histogram(binned, grad, hess, live, local,
                                       4, 3, 15, allow_pallas=False))

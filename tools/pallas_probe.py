@@ -1,0 +1,84 @@
+"""Tiny-shape Mosaic compile/execute probe for the two Pallas kernels.
+
+The Pallas histogram kernel (models/gbdt/hist_pallas.py) and flash
+attention (parallel/flash.py) pass AOT Mosaic *lowering* on CPU
+(tests/parallel/test_mosaic_lowering.py) but had never been compiled
+or executed by a real TPU backend before the 2026-07-31 window — which
+died before reaching them. This probe runs both at small shapes (a
+few-second compile) and checks numerics against the XLA formulations,
+so a short window answers "does Mosaic-on-axon work at all?" before
+any big benchmark timebox is spent. Prints one JSON line per kernel.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    sys.path.insert(0, ".")
+    from bench import wait_for_backend
+    wait_for_backend(metric="pallas_probe", unit="ok")
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"probe": "pallas", "error": "not on tpu"}))
+        return
+
+    rng = np.random.default_rng(0)
+
+    # -- histogram kernel at small shape vs the XLA path --------------
+    from mmlspark_tpu.models.gbdt.hist_pallas import pallas_level_histogram
+    from mmlspark_tpu.models.gbdt.trainer import _level_histogram
+    n, f, b, width = 16384, 8, 255, 8
+    binned = jnp.asarray(rng.integers(0, b, size=(n, f), dtype=np.int64)
+                         .astype(np.uint8))
+    grad = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    hess = jnp.asarray(rng.uniform(0.1, 1.0, size=n).astype(np.float32))
+    live = jnp.asarray((rng.random(n) < 0.9).astype(np.float32))
+    local = jnp.asarray(rng.integers(0, width, size=n, dtype=np.int64)
+                        .astype(np.int32))
+    try:
+        t0 = time.perf_counter()
+        out = jax.jit(lambda *a: pallas_level_histogram(
+            *a, width, f, b))(binned, grad, hess, live, local)
+        out.block_until_ready()
+        compile_s = time.perf_counter() - t0
+        ref = np.asarray(_level_histogram(
+            binned, grad, hess, live, local, width, f, b,
+            allow_pallas=False))
+        err = float(np.abs(np.asarray(out) - ref).max())
+        print(json.dumps({"probe": "pallas_hist", "ok": err < 1e-3,
+                          "max_err": err,
+                          "compile_s": round(compile_s, 1)}), flush=True)
+    except Exception as e:
+        print(json.dumps({"probe": "pallas_hist",
+                          "error": str(e)[:400]}), flush=True)
+
+    # -- flash attention at small shape vs blockwise ------------------
+    try:
+        from mmlspark_tpu.parallel.attention import blockwise_attention
+        from mmlspark_tpu.parallel.flash import flash_attention
+        bsz, seq, h, d = 1, 512, 2, 64
+        q, k, v = (jnp.asarray(rng.normal(size=(bsz, seq, h, d))
+                               .astype(np.float32)) for _ in range(3))
+        t0 = time.perf_counter()
+        fo = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=True))(q, k, v)
+        fo.block_until_ready()
+        compile_s = time.perf_counter() - t0
+        bo = blockwise_attention(q, k, v, causal=True)
+        err = float(jnp.abs(fo - bo).max())
+        print(json.dumps({"probe": "pallas_flash", "ok": err < 1e-4,
+                          "max_err": err,
+                          "compile_s": round(compile_s, 1)}), flush=True)
+    except Exception as e:
+        print(json.dumps({"probe": "pallas_flash",
+                          "error": str(e)[:400]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
