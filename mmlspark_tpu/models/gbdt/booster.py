@@ -226,7 +226,9 @@ class BoosterArrays:
             raise ValueError(
                 "this booster has no binned thresholds (imported from a "
                 "LightGBM model string, which carries raw-value "
-                "thresholds only) — use predict_fn on raw features")
+                "thresholds only) — use predict_fn on raw features, or "
+                "derive_binning() to recover a binning from the model's "
+                "own splits and score binned")
         sf = jnp.asarray(self.split_feature)
         tb = jnp.asarray(self.threshold_bin)
         nv = jnp.asarray(self.node_value)
@@ -262,6 +264,101 @@ class BoosterArrays:
             return acc[:, 0] if k == 1 else acc
 
         return predict_binned
+
+    def derive_binning(self) -> "tuple[DerivedBinning, BoosterArrays]":
+        """Recover a binning from the model's own split thresholds so an
+        IMPORTED model string (raw-value thresholds only, threshold_bin
+        stamped -1) can use the fast ``predict_binned_fn`` path.
+
+        The per-feature sorted unique thresholds T define bins
+        ``bin(x) = 1 + #{T_i < x}`` (bin 0 reserved as the always-left
+        missing sentinel, mirroring trained models); a node splitting at
+        T[j] gets ``threshold_bin = 1 + j``, so the binned compare
+        ``bin(x) <= 1 + j  <=>  x <= T[j]`` reproduces raw routing
+        exactly. Returns ``(binning, booster)`` where ``booster`` is a
+        copy with ``threshold_bin`` filled.
+
+        Missing-value semantics follow ``_go_left_fn``: NaN (and, for
+        zero-as-missing nodes, exact 0.0) route per-node by
+        decision_type. A single bin id can only express a PER-FEATURE
+        policy, so ``DerivedBinning.transform`` maps NaN/0.0 when every
+        node on that feature agrees (always-left -> bin 0, always-right
+        -> past every threshold, NaN-compares-as-0.0 -> bin(0.0)) and
+        raises when the model mixes directions for a feature whose
+        column actually contains such values. Categorical models route
+        by raw-value bitsets and are refused (same as
+        ``predict_binned_fn``).
+        """
+        if self.has_categorical:
+            raise NotImplementedError(
+                "binned scoring routes by threshold_bin; categorical "
+                "splits route by raw-value bitset — use predict_fn")
+        import dataclasses
+
+        thresholds: List[np.ndarray] = []
+        nodes_per_feature: List[List[tuple]] = [
+            [] for _ in range(self.num_features)]
+        internal = self.split_feature >= 0
+        for t, m in zip(*np.nonzero(internal)):
+            d = int(self.decision_type[t, m]) \
+                if self.decision_type is not None else None
+            nodes_per_feature[int(self.split_feature[t, m])].append(
+                (float(self.threshold_value[t, m]), d))
+        nan_bin = np.zeros(self.num_features, dtype=np.int64)
+        zero_bin = np.full(self.num_features, -1, dtype=np.int64)
+        for f in range(self.num_features):
+            tf = np.unique(np.asarray(
+                [thr for thr, _ in nodes_per_feature[f]], dtype=np.float64))
+            thresholds.append(tf)
+            k = len(tf)
+            # NaN policy: where does a NaN in this column have to land?
+            pol = set()
+            for _, d in nodes_per_feature[f]:
+                if d is None:
+                    pol.add("left")     # trained no-cat: NaN routes left
+                else:
+                    mt = (d >> 2) & 3
+                    dl = (d & 2) != 0
+                    # _go_left_fn: only mt==2 treats NaN as missing;
+                    # mt==0 and the out-of-spec mt==3 compare NaN as
+                    # 0.0, and mt==1 treats NaN (and 0.0) as missing
+                    pol.add("zero" if mt in (0, 3)
+                            else ("left" if dl else "right"))
+            if not pol or pol == {"left"}:
+                nan_bin[f] = 0
+            elif pol == {"right"}:
+                nan_bin[f] = k + 1
+            elif pol == {"zero"}:
+                nan_bin[f] = 1 + int(np.searchsorted(tf, 0.0, side="left"))
+            else:
+                nan_bin[f] = -1     # mixed: unsupported if NaN appears
+            # zero-as-missing policy (decision_type missing_type == 1):
+            # exact 0.0 routes by default direction at those nodes
+            zpol = set()
+            for _, d in nodes_per_feature[f]:
+                if d is not None and ((d >> 2) & 3) == 1:
+                    zpol.add("left" if (d & 2) != 0 else "right")
+                else:
+                    zpol.add("compare")
+            if zpol and zpol != {"compare"}:
+                if zpol == {"left"}:
+                    zero_bin[f] = 0
+                elif zpol == {"right"}:
+                    zero_bin[f] = k + 1
+                else:
+                    zero_bin[f] = -2    # mixed: unsupported if 0.0 appears
+        max_bin_id = max((len(t) + 1 for t in thresholds), default=1)
+        binning = DerivedBinning(thresholds=thresholds, nan_bin=nan_bin,
+                                 zero_bin=zero_bin,
+                                 num_bins=max_bin_id + 1)
+        tb = np.array(self.threshold_bin, copy=True)
+        for t, m in zip(*np.nonzero(internal)):
+            f = int(self.split_feature[t, m])
+            tb[t, m] = 1 + int(np.searchsorted(
+                thresholds[f], float(self.threshold_value[t, m]),
+                side="left"))
+        booster = dataclasses.replace(self, threshold_bin=tb)
+        return binning, booster
 
     def leaf_index_fn(self):
         """(N, F) -> (N, T) final node slot per tree (predLeaf analog,
@@ -908,3 +1005,62 @@ class BoosterArrays:
             cat_bitset=(np.asarray(state["cat_bitset"]).astype(np.uint32)
                         if state.get("cat_bitset") is not None else None),
         )
+
+
+@dataclass
+class DerivedBinning:
+    """Per-feature threshold tables recovered from an imported model's
+    splits (``BoosterArrays.derive_binning``). ``transform`` bins raw
+    features for ``predict_binned_fn``: ``bin(x) = 1 + #{T_i < x}``,
+    with NaN / zero-as-missing values mapped per the model's (uniform)
+    per-feature policy and refused where the model mixes directions.
+    """
+
+    thresholds: List[np.ndarray]    # per feature, sorted unique float64
+    nan_bin: np.ndarray             # (F,) where NaN lands; -1 = refuse
+    zero_bin: np.ndarray            # (F,) where exact 0.0 lands;
+                                    # -1 = compares normally, -2 = refuse
+    num_bins: int                   # max bin id + 1 (dtype sizing)
+
+    @property
+    def dtype(self):
+        from mmlspark_tpu.ops.ingest import binned_ingest_dtype
+        return binned_ingest_dtype(self.num_bins)
+
+    def transform(self, x: np.ndarray) -> np.ndarray:
+        # Not delegated to BinMapper.transform (the native
+        # mmls_bin_matrix path): that binning fixes NaN -> bin 0 and has
+        # no zero-as-missing sentinel, while here both land per the
+        # model's per-feature policy — the searchsorted formula below is
+        # defined by derive_binning's bin(x) = 1 + #{T_i < x} contract,
+        # not borrowed from BinMapper.
+        x = np.asarray(x)
+        n, f = x.shape
+        if f != len(self.thresholds):
+            raise ValueError(f"expected {len(self.thresholds)} features, "
+                             f"got {f}")
+        out = np.empty((n, f), dtype=self.dtype)
+        for j, tf in enumerate(self.thresholds):
+            col = np.asarray(x[:, j], dtype=np.float64)
+            bins = 1 + np.searchsorted(tf, col, side="left")
+            nan_mask = np.isnan(col)
+            if nan_mask.any():
+                if self.nan_bin[j] < 0:
+                    raise ValueError(
+                        f"feature {j}: this model mixes NaN default "
+                        "directions across nodes, which a per-feature "
+                        "bin id cannot express — use predict_fn for "
+                        "rows with NaN in this column")
+                bins[nan_mask] = self.nan_bin[j]
+            if self.zero_bin[j] != -1:
+                zmask = col == 0.0
+                if zmask.any():
+                    if self.zero_bin[j] == -2:
+                        raise ValueError(
+                            f"feature {j}: this model mixes "
+                            "zero-as-missing directions across nodes — "
+                            "use predict_fn for rows with 0.0 in this "
+                            "column")
+                    bins[zmask] = self.zero_bin[j]
+            out[:, j] = bins
+        return out

@@ -69,5 +69,15 @@ def chunked_device_put(arr: np.ndarray, sharding=None,
 
 
 def binned_ingest_dtype(total_bins: int):
-    """Narrowest integer dtype holding bin ids in [0, total_bins)."""
-    return np.uint8 if total_bins <= 256 else np.int32
+    """Narrowest integer dtype holding bin ids in [0, total_bins).
+
+    The single source of truth for bin-id dtype selection (binned
+    scoring gathers run in the input dtype, so narrower moves fewer
+    bytes): uint8 for the common <=256-bin configs, uint16 up to 65536
+    (derived binnings from deep imported models can exceed 256
+    thresholds per feature), int32 beyond."""
+    if total_bins <= 256:
+        return np.uint8
+    if total_bins <= 65536:
+        return np.uint16
+    return np.int32

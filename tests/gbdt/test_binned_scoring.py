@@ -106,3 +106,157 @@ def test_categorical_model_refuses_binned(rng):
     if res.booster.has_categorical:
         with pytest.raises(NotImplementedError, match="categorical"):
             res.booster.predict_binned_fn()
+
+
+# -- derived binning for imported model strings ---------------------------
+
+def _import_roundtrip(booster):
+    return BoosterArrays.load_model_string(booster.save_model_string())
+
+
+def test_derived_binning_matches_raw_exactly(rng):
+    """An imported model string carries raw thresholds only; deriving a
+    binning from its own splits must reproduce predict_fn exactly."""
+    booster, mapper, x, _ = _fit(rng)
+    imported = _import_roundtrip(booster)
+    with pytest.raises(ValueError, match="no binned thresholds"):
+        imported.predict_binned_fn()
+    binning, derived = imported.derive_binning()
+    raw = np.asarray(imported.predict_jit()(x))
+    via = np.asarray(derived.predict_binned_jit()(binning.transform(x)))
+    np.testing.assert_array_equal(raw, via)
+    # unseen rows too (values beyond every threshold, between thresholds)
+    x_new = rng.normal(size=(500, x.shape[1])) * 3
+    np.testing.assert_array_equal(
+        np.asarray(imported.predict_jit()(x_new)),
+        np.asarray(derived.predict_binned_jit()(binning.transform(x_new))))
+
+
+def test_derived_binning_threshold_boundary_rows(rng):
+    """Rows sitting EXACTLY on split thresholds route inclusively
+    (x <= t goes left) in both paths."""
+    booster, mapper, x, _ = _fit(rng)
+    imported = _import_roundtrip(booster)
+    binning, derived = imported.derive_binning()
+    internal = imported.split_feature >= 0
+    feats = imported.split_feature[internal]
+    thrs = imported.threshold_value[internal]
+    x_edge = np.tile(x[:1], (min(64, len(feats)), 1))
+    for i in range(x_edge.shape[0]):
+        x_edge[i, feats[i]] = thrs[i]
+    np.testing.assert_array_equal(
+        np.asarray(imported.predict_jit()(x_edge)),
+        np.asarray(derived.predict_binned_jit()(
+            binning.transform(x_edge))))
+
+
+def test_derived_binning_nan_policy_uniform(rng):
+    """Imported trees carry decision_type; NaN routes per the (uniform)
+    per-feature default direction in both paths."""
+    booster, mapper, x, _ = _fit(rng)
+    imported = _import_roundtrip(booster)
+    binning, derived = imported.derive_binning()
+    x_nan = x[:200].copy()
+    x_nan[::3, 0] = np.nan
+    x_nan[::5, 2] = np.nan
+    raw = np.asarray(imported.predict_jit()(x_nan))
+    via = np.asarray(derived.predict_binned_jit()(
+        binning.transform(x_nan)))
+    np.testing.assert_array_equal(raw, via)
+
+
+def test_derived_binning_mixed_nan_directions_refused(rng):
+    booster, mapper, x, _ = _fit(rng)
+    imported = _import_roundtrip(booster)
+    # force mixed NaN default directions on feature 0's nodes
+    dt = np.array(imported.decision_type, copy=True) \
+        if imported.decision_type is not None \
+        else np.zeros_like(imported.split_feature, dtype=np.int8)
+    nodes = np.nonzero(imported.split_feature == 0)
+    assert len(nodes[0]) >= 2, "fixture needs >= 2 splits on feature 0"
+    # missing_type nan (2 << 2 = 8); alternate default-left bit
+    for i, (t, m) in enumerate(zip(*nodes)):
+        dt[t, m] = np.int8(8 | (2 if i % 2 == 0 else 0))
+    import dataclasses
+    mixed = dataclasses.replace(imported, decision_type=dt)
+    binning, derived = mixed.derive_binning()
+    x_nan = x[:50].copy()
+    x_nan[::2, 0] = np.nan
+    with pytest.raises(ValueError, match="mixes NaN default directions"):
+        binning.transform(x_nan)
+    # finite rows still fine and exact
+    np.testing.assert_array_equal(
+        np.asarray(mixed.predict_jit()(x[:100])),
+        np.asarray(derived.predict_binned_jit()(
+            binning.transform(x[:100]))))
+
+
+def test_derived_binning_zero_as_missing(rng):
+    """All-nodes zero-as-missing with a uniform direction maps exact
+    0.0 to the sentinel bin; both paths agree."""
+    booster, mapper, x, _ = _fit(rng)
+    imported = _import_roundtrip(booster)
+    dt = np.zeros_like(imported.split_feature, dtype=np.int8)
+    internal = imported.split_feature >= 0
+    # missing_type zero (1 << 2 = 4) + default-left (2) on every node
+    dt[internal] = np.int8(4 | 2)
+    import dataclasses
+    zmodel = dataclasses.replace(imported, decision_type=dt)
+    binning, derived = zmodel.derive_binning()
+    x_z = x[:200].copy()
+    x_z[::4, 0] = 0.0
+    x_z[::7, 3] = 0.0
+    np.testing.assert_array_equal(
+        np.asarray(zmodel.predict_jit()(x_z)),
+        np.asarray(derived.predict_binned_jit()(binning.transform(x_z))))
+
+
+def test_derived_binning_dtype_is_narrow(rng):
+    booster, mapper, x, _ = _fit(rng)
+    imported = _import_roundtrip(booster)
+    binning, _ = imported.derive_binning()
+    assert binning.transform(x[:10]).dtype == np.uint8
+
+
+def _with_decision(imported, dt_val):
+    import dataclasses
+    dt = np.zeros_like(imported.split_feature, dtype=np.int8)
+    dt[imported.split_feature >= 0] = np.int8(dt_val)
+    return dataclasses.replace(imported, decision_type=dt)
+
+
+def test_derived_binning_nan_right_policy(rng):
+    """All nodes NaN-missing + default-RIGHT: NaN maps past every
+    threshold (bin k+1) and both paths agree."""
+    booster, mapper, x, _ = _fit(rng)
+    # missing_type nan (2 << 2 = 8), default-left bit clear
+    model = _with_decision(_import_roundtrip(booster), 8)
+    binning, derived = model.derive_binning()
+    assert (binning.nan_bin[[len(t) > 0 for t in binning.thresholds]]
+            > 0).all()
+    x_nan = x[:200].copy()
+    x_nan[::3, 0] = np.nan
+    x_nan[::5, 2] = np.nan
+    np.testing.assert_array_equal(
+        np.asarray(model.predict_jit()(x_nan)),
+        np.asarray(derived.predict_binned_jit()(
+            binning.transform(x_nan))))
+
+
+@pytest.mark.parametrize("dt_val", [0, 12])
+def test_derived_binning_nan_compares_as_zero_policy(rng, dt_val):
+    """missing_type none (0) — and the out-of-spec bits value 3 (12)
+    which _go_left_fn also treats as compare — converts NaN to 0.0
+    before the threshold compare; the derived binning maps NaN to
+    bin(0.0)."""
+    booster, mapper, x, _ = _fit(rng)
+    model = _with_decision(_import_roundtrip(booster), dt_val)
+    binning, derived = model.derive_binning()
+    x_nan = x[:200].copy()
+    x_nan[::3, 0] = np.nan
+    x_nan[::4, 1] = np.nan
+    x_nan[::5, 2] = np.nan
+    np.testing.assert_array_equal(
+        np.asarray(model.predict_jit()(x_nan)),
+        np.asarray(derived.predict_binned_jit()(
+            binning.transform(x_nan))))

@@ -33,7 +33,9 @@ def test_chunked_sharded_ingest(mesh8, rng):
 def test_binned_dtype_selection():
     assert binned_ingest_dtype(255) == np.uint8
     assert binned_ingest_dtype(256) == np.uint8
-    assert binned_ingest_dtype(257) == np.int32
+    assert binned_ingest_dtype(257) == np.uint16
+    assert binned_ingest_dtype(65536) == np.uint16
+    assert binned_ingest_dtype(65537) == np.int32
 
 
 def test_uint8_binned_training_parity(rng):

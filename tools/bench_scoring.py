@@ -88,6 +88,16 @@ def main():
 
     binned_incl = timed(bin_and_score, x)
 
+    # imported-model path: a LightGBM model string carries raw-value
+    # thresholds only; derive_binning() recovers per-feature threshold
+    # tables from the model's own splits so imports score binned too
+    from mmlspark_tpu.models.gbdt.booster import BoosterArrays
+    imported = BoosterArrays.load_model_string(booster.save_model_string())
+    derived_binning, derived = imported.derive_binning()
+    derived_fn = derived.predict_binned_jit()
+    xdb = derived_binning.transform(x)
+    derived_mrows = timed(derived_fn, xdb)
+
     # anchor: sklearn HistGradientBoosting predict, same tree count/
     # depth family, measured on this machine (single-core)
     sk_mrows = None
@@ -117,6 +127,7 @@ def main():
             "raw": round(raw_mrows, 4),
             "binned": round(binned_mrows, 4),
             "binned_incl_binning": round(binned_incl, 4),
+            "imported_derived_binned": round(derived_mrows, 4),
             "sklearn_anchor": None if sk_mrows is None
             else round(sk_mrows, 4),
         },
