@@ -13,6 +13,9 @@ trainer can adopt the winner per hardware:
   C. per-feat  — fori_loop over features, (N, 3) segments each
                  (trainer default outside shard_map)
   D. scatter   — zeros.at[idx].add on the flat (width*F*B, 3) table
+  E. onehot    — chunked one-hot contraction on the MXU (pure-XLA
+                 insurance for the Pallas kernel; env-selectable)
+  F. pallas    — the Mosaic kernel (TPU only)
 
 Run: python bench_hist.py [N] [--cpu] (default 2_000_000). Prints one
 JSON line per variant.
@@ -96,6 +99,17 @@ def main():
         return pallas_level_histogram(binned, grad, hess, live, local,
                                       width, f, b)
 
+    def variant_onehot():
+        import os
+
+        from mmlspark_tpu.models.gbdt.trainer import _level_histogram
+        os.environ["MMLSPARK_TPU_HIST_FORMULATION"] = "onehot"
+        try:
+            return _level_histogram(binned, grad, hess, live, local,
+                                    width, f, b, allow_pallas=False)
+        finally:
+            os.environ.pop("MMLSPARK_TPU_HIST_FORMULATION", None)
+
     # Order = measurement priority: the 2026-07-31 TPU window died
     # mid-run, so the most decision-relevant variants go first (pallas
     # had never been Mosaic-compiled; scatter hung in remote compile
@@ -103,6 +117,7 @@ def main():
     # later variant in the same process, so tpu_day.sh runs subsets in
     # separately-timeboxed steps via --only=name1,name2.
     variants = {"pallas": variant_pallas,
+                "onehot": variant_onehot,
                 "per_feature": variant_per_feature,
                 "separate": variant_separate,
                 "stacked": variant_stacked,

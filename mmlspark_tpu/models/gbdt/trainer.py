@@ -234,7 +234,9 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
     shard_map. Under shard_map the fori_loop carry would need manual
     varying-axes casts, so those callers use the separate formulation
     on TPU and keep the fused scatter on CPU (the long-tested path).
-    MMLSPARK_TPU_HIST_FORMULATION=per_feature|separate|fused overrides.
+    MMLSPARK_TPU_HIST_FORMULATION=per_feature|separate|fused|onehot
+    overrides (onehot: chunked MXU one-hot contraction, insurance for
+    the Pallas kernel).
     """
     import jax
     import jax.numpy as jnp
@@ -255,7 +257,8 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
                                       width, f, b)
 
     forced = os.environ.get("MMLSPARK_TPU_HIST_FORMULATION", "").strip()
-    if forced and forced not in ("per_feature", "separate", "fused"):
+    if forced and forced not in ("per_feature", "separate", "fused",
+                                 "onehot"):
         # a mistyped value silently running the default would mislabel
         # an A/B measurement — warn loudly (once per process)
         global _WARNED_BAD_FORMULATION
@@ -264,7 +267,7 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
             import warnings
             warnings.warn(
                 f"MMLSPARK_TPU_HIST_FORMULATION={forced!r} is not one "
-                "of per_feature|separate|fused; using the default "
+                "of per_feature|separate|fused|onehot; using the default "
                 "formulation instead", stacklevel=2)
         forced = ""
     # Resolve which formulation runs. per_feature's fori_loop carry is
@@ -281,8 +284,46 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
         choice = "separate"
     else:
         choice = "fused"
-    if choice == "per_feature" and in_shard_map:
+    if choice in ("per_feature", "onehot") and in_shard_map:
         choice = "separate"
+
+    if choice == "onehot":
+        # MXU formulation in pure XLA (insurance for the Pallas kernel,
+        # which restructures the same contraction without materializing
+        # the one-hots): rows are chunked; per chunk the bin one-hot
+        # (chunk, F, B) is contracted against the node-expanded stats
+        # (chunk, width*3) in ONE f32 dot — bin accumulation becomes a
+        # (F*B, chunk) @ (chunk, width*3) matmul instead of a scatter.
+        # Sum order differs from segment_sum, so grad/hess match the
+        # other formulations to float tolerance (counts exactly).
+        n = binned.shape[0]
+        chunk = min(4096, n)
+        pad = (-n) % chunk
+        data = jnp.stack([grad * live, hess * live, live], axis=-1)
+        bc = jnp.pad(binned, ((0, pad), (0, 0))) if pad else binned
+        dc = jnp.pad(data, ((0, pad), (0, 0))) if pad else data
+        # padded rows carry all-zero stats, so whichever node their
+        # zero-filled local id points at receives nothing
+        lc = jnp.pad(local, (0, pad)) if pad else local
+        nb = jnp.arange(b, dtype=jnp.int32)
+        nw = jnp.arange(width, dtype=jnp.int32)
+
+        def chunk_body(acc, xs):
+            cb, cd, cl = xs
+            b1h = (cb.astype(jnp.int32)[:, :, None] == nb).astype(
+                jnp.float32)                            # (chunk, F, B)
+            n1h = (cl[:, None] == nw).astype(jnp.float32)
+            d2 = (n1h[:, :, None] * cd[:, None, :]).reshape(
+                chunk, width * 3)
+            part = jnp.einsum("rfb,rk->fbk", b1h, d2,
+                              preferred_element_type=jnp.float32)
+            return acc + part, None
+
+        xs = (bc.reshape(-1, chunk, f), dc.reshape(-1, chunk, 3),
+              lc.reshape(-1, chunk))
+        acc0 = jnp.zeros((f, b, width * 3), jnp.float32)
+        acc, _ = jax.lax.scan(chunk_body, acc0, xs)
+        return acc.reshape(f, b, width, 3).transpose(2, 0, 1, 3)
 
     if choice == "per_feature":
         data = jnp.stack([grad * live, hess * live, live], axis=-1)

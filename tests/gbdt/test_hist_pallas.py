@@ -256,3 +256,30 @@ def test_formulation_override_bogus_value_warns_and_uses_default(
     out = np.asarray(_level_histogram(binned, grad, hess, live, local,
                                       4, 3, 15, allow_pallas=False))
     np.testing.assert_array_equal(ref, out)
+
+
+def test_onehot_formulation_matches_to_tolerance(monkeypatch):
+    """The MXU one-hot contraction sums in a different order than
+    segment_sum: counts must be exact (integer f32 sums), grad/hess to
+    float tolerance."""
+    binned, grad, hess, live, local = _case(5000, 7, 31, 8, seed=5)
+    ref = np.asarray(_level_histogram(binned, grad, hess, live, local,
+                                      8, 7, 31, allow_pallas=False))
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_FORMULATION", "onehot")
+    out = np.asarray(_level_histogram(binned, grad, hess, live, local,
+                                      8, 7, 31, allow_pallas=False))
+    np.testing.assert_array_equal(out[..., 2], ref[..., 2])
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-4)
+
+
+def test_onehot_formulation_padded_tail(monkeypatch):
+    """n not divisible by the chunk: padded rows must contribute
+    nothing."""
+    binned, grad, hess, live, local = _case(4999, 3, 15, 4, seed=6)
+    ref = np.asarray(_level_histogram(binned, grad, hess, live, local,
+                                      4, 3, 15, allow_pallas=False))
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_FORMULATION", "onehot")
+    out = np.asarray(_level_histogram(binned, grad, hess, live, local,
+                                      4, 3, 15, allow_pallas=False))
+    np.testing.assert_array_equal(out[..., 2], ref[..., 2])
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-4)

@@ -234,11 +234,12 @@ def test_vw_sharded_pass_lowers_for_tpu():
     {"MMLSPARK_TPU_PALLAS_HIST": "1",
      "MMLSPARK_TPU_PALLAS_FORCE_COMPILE": "1"},
     {"MMLSPARK_TPU_HIST_SUB": "1"},
+    {"MMLSPARK_TPU_HIST_FORMULATION": "onehot"},
 ])
 def test_full_fused_step_lowers_for_tpu(monkeypatch, flags):
     """The ENTIRE fused boosting step (gradients -> tree build -> raw
-    update -> metrics) at bench config, in all three kernel
-    configurations tpu_day.sh will run — the exact per-iteration
+    update -> metrics) at bench config, in every kernel
+    configuration tpu_day.sh will run — the exact per-iteration
     program bench.py dispatches."""
     for kk, vv in flags.items():
         monkeypatch.setenv(kk, vv)

@@ -85,8 +85,11 @@ MMLSPARK_TPU_HIST_FORMULATION=fused run_xfail bench_fused 1200 python bench.py
 #    hung for 20+ min in the first window; pallas has never compiled)
 if [ "$REHEARSAL" = "1" ]; then HN=100000; else HN=2000000; fi
 run hist_pallas 600 python bench_hist.py $HN $CPU --only=pallas
+run_xfail hist_onehot 600 python bench_hist.py $HN $CPU --only=onehot
 run hist_xla 900 python bench_hist.py $HN $CPU --only=per_feature,separate,stacked
 run_xfail hist_scatter 600 python bench_hist.py $HN $CPU --only=scatter
+# if onehot wins the microbench, this measures it end-to-end
+MMLSPARK_TPU_HIST_FORMULATION=onehot run_xfail bench_onehot 1500 python bench.py
 # 4. profile the best-so-far default for op-level attribution
 BENCH_PROFILE_DIR="$OUT/trace" run bench_profiled 1500 python bench.py
 # 5. the other north stars
