@@ -296,8 +296,35 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
         # (F*B, chunk) @ (chunk, width*3) matmul instead of a scatter.
         # Sum order differs from segment_sum, so grad/hess match the
         # other formulations to float tolerance (counts exactly).
+        # On-window tuning knobs (no code edits during a TPU window):
+        # MMLSPARK_TPU_ONEHOT_CHUNK (rows per dot, default 4096) and
+        # MMLSPARK_TPU_ONEHOT_BF16=1 (bf16 operands at 2x MXU rate and
+        # half the one-hot bandwidth; f32 accumulation. Counts stay
+        # exact — 0/1 and the stat values are bf16-representable only
+        # for counts — while grad/hess pick up bf16 input rounding,
+        # ~0.4% relative: an accuracy-vs-speed A/B, not a default).
         n = binned.shape[0]
-        chunk = min(4096, n)
+        try:
+            chunk = int(os.environ.get("MMLSPARK_TPU_ONEHOT_CHUNK",
+                                       "4096"))
+            if chunk < 1:
+                raise ValueError
+        except ValueError:
+            # same contract as the formulation knob: a bad value must
+            # not abort (or silently mislabel) a measurement run
+            # (_WARNED_BAD_FORMULATION is declared global above)
+            if not _WARNED_BAD_FORMULATION:
+                _WARNED_BAD_FORMULATION = True
+                import warnings
+                warnings.warn(
+                    "MMLSPARK_TPU_ONEHOT_CHUNK="
+                    f"{os.environ['MMLSPARK_TPU_ONEHOT_CHUNK']!r} is "
+                    "not a positive integer; using 4096", stacklevel=2)
+            chunk = 4096
+        chunk = min(chunk, n)
+        from mmlspark_tpu.core.utils import env_flag
+        op_dtype = (jnp.bfloat16 if env_flag("MMLSPARK_TPU_ONEHOT_BF16")
+                    else jnp.float32)
         pad = (-n) % chunk
         data = jnp.stack([grad * live, hess * live, live], axis=-1)
         bc = jnp.pad(binned, ((0, pad), (0, 0))) if pad else binned
@@ -311,10 +338,10 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
         def chunk_body(acc, xs):
             cb, cd, cl = xs
             b1h = (cb.astype(jnp.int32)[:, :, None] == nb).astype(
-                jnp.float32)                            # (chunk, F, B)
+                op_dtype)                               # (chunk, F, B)
             n1h = (cl[:, None] == nw).astype(jnp.float32)
             d2 = (n1h[:, :, None] * cd[:, None, :]).reshape(
-                chunk, width * 3)
+                chunk, width * 3).astype(op_dtype)
             part = jnp.einsum("rfb,rk->fbk", b1h, d2,
                               preferred_element_type=jnp.float32)
             return acc + part, None

@@ -283,3 +283,35 @@ def test_onehot_formulation_padded_tail(monkeypatch):
                                       4, 3, 15, allow_pallas=False))
     np.testing.assert_array_equal(out[..., 2], ref[..., 2])
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-4)
+
+
+@pytest.mark.parametrize("extra,rtol", [
+    ({"MMLSPARK_TPU_ONEHOT_CHUNK": "3000"}, 2e-5),  # non-divisor
+    ({"MMLSPARK_TPU_ONEHOT_CHUNK": "zero?"}, 2e-5),  # bad: warn + default
+    ({"MMLSPARK_TPU_ONEHOT_BF16": "1"}, 1e-2),
+])
+def test_onehot_tuning_knobs(monkeypatch, extra, rtol):
+    """Chunk-size and bf16 knobs (on-window A/Bs) keep counts exact and
+    grad/hess within the knob's documented tolerance."""
+    binned, grad, hess, live, local = _case(5000, 7, 31, 8, seed=7)
+    ref = np.asarray(_level_histogram(binned, grad, hess, live, local,
+                                      8, 7, 31, allow_pallas=False))
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_FORMULATION", "onehot")
+    for k, v in extra.items():
+        monkeypatch.setenv(k, v)
+    bad_chunk = not extra.get("MMLSPARK_TPU_ONEHOT_CHUNK",
+                              "1").lstrip("-").isdigit()
+    if bad_chunk:
+        from mmlspark_tpu.models.gbdt import trainer as trainer_mod
+        monkeypatch.setattr(trainer_mod, "_WARNED_BAD_FORMULATION",
+                            False)
+        with pytest.warns(UserWarning, match="ONEHOT_CHUNK"):
+            out = np.asarray(_level_histogram(
+                binned, grad, hess, live, local, 8, 7, 31,
+                allow_pallas=False))
+    else:
+        out = np.asarray(_level_histogram(
+            binned, grad, hess, live, local, 8, 7, 31,
+            allow_pallas=False))
+    np.testing.assert_array_equal(out[..., 2], ref[..., 2])
+    np.testing.assert_allclose(out, ref, rtol=rtol, atol=rtol * 10)
