@@ -89,6 +89,52 @@ class BoosterArrays:
     def num_nodes(self) -> int:
         return self.split_feature.shape[1]
 
+    @property
+    def supports_binned(self) -> bool:
+        """Single source of truth for binned-scoring eligibility
+        (``predict_binned_fn``'s raise-paths and the model-level
+        ``binnedScoring`` gate both use it): numerical-only routing and
+        valid bin thresholds. Cached — the (T, M) scan is constant per
+        booster and transform runs in serving loops."""
+        cached = self.__dict__.get("_supports_binned")
+        if cached is None:
+            cached = (not self.has_categorical
+                      and not bool((self.threshold_bin[
+                          self.split_feature >= 0] < 0).any()))
+            self.__dict__["_supports_binned"] = cached
+        return cached
+
+    @property
+    def zero_premap_mode(self) -> str:
+        """How exact-0.0 inputs must be handled before binned scoring:
+
+        - ``"none"``: no zero-as-missing nodes — bin raw values as-is.
+        - ``"all_left"``: every internal node routes missing (0.0/NaN)
+          left (the stamp trained zero_as_missing boosters carry,
+          trainer decision bits 6) — map 0.0 -> NaN before
+          ``BinMapper.transform`` so zeros enter bin 0, exactly as fit
+          did.
+        - ``"unsupported"``: mixed per-node zero semantics a single
+          per-feature bin id cannot express — use ``predict_fn``.
+        """
+        cached = self.__dict__.get("_zero_premap_mode")
+        if cached is None:
+            if self.decision_type is None:
+                cached = "none"
+            else:
+                internal = self.split_feature >= 0
+                dt = self.decision_type[internal]
+                num_dt = dt[(dt & 1) == 0]   # numerical internal nodes
+                mt1 = ((num_dt >> 2) & 3) == 1
+                if not bool(mt1.any()):
+                    cached = "none"
+                elif bool((mt1 & ((num_dt & 2) != 0)).all()):
+                    cached = "all_left"
+                else:
+                    cached = "unsupported"
+            self.__dict__["_zero_premap_mode"] = cached
+        return cached
+
     def _go_left_fn(self):
         """Shared per-step routing: (tree_idx, node, fx) -> bool (N,).
 
@@ -217,12 +263,11 @@ class BoosterArrays:
         import jax
         import jax.numpy as jnp
 
-        if self.has_categorical:
-            raise NotImplementedError(
-                "binned scoring routes by threshold_bin; categorical "
-                "splits route by raw-value bitset — use predict_fn")
-        internal = self.split_feature >= 0
-        if bool((self.threshold_bin[internal] < 0).any()):
+        if not self.supports_binned:
+            if self.has_categorical:
+                raise NotImplementedError(
+                    "binned scoring routes by threshold_bin; categorical "
+                    "splits route by raw-value bitset — use predict_fn")
             raise ValueError(
                 "this booster has no binned thresholds (imported from a "
                 "LightGBM model string, which carries raw-value "

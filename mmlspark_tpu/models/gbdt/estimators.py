@@ -654,7 +654,18 @@ class _LightGBMModelBase(Model, _LightGBMParams):
         "startIteration (<0 = all; LightGBM predict num_iteration)",
         to_int, default=-1)
 
+    binnedScoring = Param(
+        "binnedScoring", "route transform through the binned-compare "
+        "scorer (bin via the C++ data plane, then compare uint8 bin "
+        "ids instead of float thresholds). Identical outputs, pinned "
+        "by tests. Opt-in: the traversal itself is ~2x faster once "
+        "rows fall out of cache (>~50k rows on one CPU core), but "
+        "binning costs ~60ns/value, so small/serving batches and "
+        "one-shot scoring are faster raw; enable for large batches or "
+        "when re-scoring the same frame", to_bool, default=False)
+
     booster: Optional[BoosterArrays] = None
+    bin_mapper = None                  # training BinMapper, persisted
     train_measures: Optional[InstrumentationMeasures] = None
     evals_result: Optional[List[Dict[str, float]]] = None
     best_iteration: int = -1
@@ -691,17 +702,43 @@ class _LightGBMModelBase(Model, _LightGBMParams):
             return sharded_apply(fn, x, self._mesh)
         return np.asarray(fn(x))
 
+    def _raw_scores(self, x: np.ndarray) -> np.ndarray:
+        """Margin scores for raw features: the binned-compare path when
+        the model carries its training BinMapper (bin ids reproduce
+        raw-threshold routing exactly — tests/gbdt/test_binned_scoring
+        pins equality incl. NaN), else the float-threshold traversal
+        (the reference's per-row JNI UDF analog,
+        booster/LightGBMBooster.scala:394,520-557)."""
+        b = self.scoring_booster
+        zmode = b.zero_premap_mode
+        if (self.get("binnedScoring") and self.bin_mapper is not None
+                and b.supports_binned and zmode != "unsupported"):
+            from mmlspark_tpu.ops.ingest import binned_ingest_dtype
+            if zmode == "all_left":
+                # zero_as_missing models: fit mapped 0.0 -> NaN before
+                # binning (zeros enter the missing bin and route left);
+                # scoring must bin through the same premap
+                x = np.where(x == 0.0, np.nan, x)
+            xb = self.bin_mapper.transform(x).astype(
+                binned_ingest_dtype(self.bin_mapper.max_num_bins))
+            return self._score(b.predict_binned_jit(), xb)
+        return self._score(b.predict_jit(), x)
+
     def _init_empty(self):
         self.booster = None
 
     def _get_state(self) -> Dict[str, Any]:
         state = self.booster.state_dict()
         state["best_iteration"] = self.best_iteration
+        if self.bin_mapper is not None:
+            state["bin_mapper"] = self.bin_mapper.to_dict()
         return state
 
     def _set_state(self, state: Dict[str, Any]) -> None:
         self.booster = BoosterArrays.from_state_dict(state)
         self.best_iteration = state.get("best_iteration", -1)
+        bm = state.get("bin_mapper")
+        self.bin_mapper = None if bm is None else BinMapper.from_dict(bm)
 
     # -- reference model methods -------------------------------------------
     def get_feature_importances(self, importance_type: str = "split") -> np.ndarray:
@@ -823,6 +860,7 @@ class LightGBMClassifier(_LightGBMBase):
             **{k: v for k, v in self._paramMap.items()
                if LightGBMClassificationModel.has_param(k)})
         model.booster = result.booster
+        model.bin_mapper = mapper
         model._mesh = self._mesh
         model.num_classes = num_class
         model.classes_ = classes
@@ -859,7 +897,7 @@ class LightGBMClassificationModel(_LightGBMModelBase):
         import jax.numpy as jnp
 
         x = self._features(df)
-        raw = self._score(self.scoring_booster.predict_jit(), x)
+        raw = self._raw_scores(x)
         if raw.ndim == 1:  # binary: margins for [neg, pos]
             raw2 = np.stack([-raw, raw], axis=1)
             prob = 1.0 / (1.0 + np.exp(-raw))
@@ -907,6 +945,7 @@ class LightGBMRegressor(_LightGBMBase):
             **{k: v for k, v in self._paramMap.items()
                if LightGBMRegressionModel.has_param(k)})
         model.booster = result.booster
+        model.bin_mapper = mapper
         model._mesh = self._mesh
         model.train_measures = measures
         model.evals_result = result.evals
@@ -917,7 +956,7 @@ class LightGBMRegressor(_LightGBMBase):
 class LightGBMRegressionModel(_LightGBMModelBase):
     def _transform(self, df: DataFrame) -> DataFrame:
         x = self._features(df)
-        raw = self._score(self.scoring_booster.predict_jit(), x)
+        raw = self._raw_scores(x)
         if self.booster.objective in ("poisson", "gamma", "tweedie"):
             raw = np.exp(raw)
         out = df.with_column(self.get("predictionCol"), raw.astype(np.float64))
@@ -956,6 +995,7 @@ class LightGBMRanker(_LightGBMBase):
             **{k: v for k, v in self._paramMap.items()
                if LightGBMRankerModel.has_param(k)})
         model.booster = result.booster
+        model.bin_mapper = mapper
         model._mesh = self._mesh
         model.train_measures = measures
         model.evals_result = result.evals
@@ -966,7 +1006,7 @@ class LightGBMRanker(_LightGBMBase):
 class LightGBMRankerModel(_LightGBMModelBase):
     def _transform(self, df: DataFrame) -> DataFrame:
         x = self._features(df)
-        raw = self._score(self.scoring_booster.predict_jit(), x)
+        raw = self._raw_scores(x)
         out = df.with_column(self.get("predictionCol"), raw.astype(np.float64))
         return self._maybe_extra_cols(out, x)
 

@@ -216,6 +216,7 @@ def _objective_kwargs(cfg: TrainConfig) -> Dict[str, Any]:
 # ---------------------------------------------------------------------------
 
 _WARNED_BAD_FORMULATION = False
+_WARNED_BAD_CHUNK = False
 
 
 def _level_histogram(binned, grad, hess, live, local, width, f, b,
@@ -312,9 +313,9 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
         except ValueError:
             # same contract as the formulation knob: a bad value must
             # not abort (or silently mislabel) a measurement run
-            # (_WARNED_BAD_FORMULATION is declared global above)
-            if not _WARNED_BAD_FORMULATION:
-                _WARNED_BAD_FORMULATION = True
+            global _WARNED_BAD_CHUNK
+            if not _WARNED_BAD_CHUNK:
+                _WARNED_BAD_CHUNK = True
                 import warnings
                 warnings.warn(
                     "MMLSPARK_TPU_ONEHOT_CHUNK="

@@ -260,3 +260,106 @@ def test_derived_binning_nan_compares_as_zero_policy(rng, dt_val):
         np.asarray(model.predict_jit()(x_nan)),
         np.asarray(derived.predict_binned_jit()(
             binning.transform(x_nan))))
+
+
+# -- model-level auto-binned transform ------------------------------------
+
+def _fit_model(rng, n=2500, f=6, **params):
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] * x[:, 1] + 0.5 * x[:, 2] > 0).astype(np.float64)
+    df = DataFrame({"features": x, "label": y})
+    m = LightGBMClassifier(numIterations=6, numLeaves=15,
+                           **params).fit(df)
+    return m, df, x
+
+
+def test_model_transform_uses_binned_path_identically(rng):
+    m, df, x = _fit_model(rng)
+    assert m.bin_mapper is not None
+    m.set("binnedScoring", True)
+    p_binned = np.asarray(m.transform(df)["probability"])
+    m.set("binnedScoring", False)
+    p_raw = np.asarray(m.transform(df)["probability"])
+    np.testing.assert_array_equal(p_binned, p_raw)
+
+
+def test_model_transform_binned_survives_save_load(rng, tmp_path):
+    from mmlspark_tpu.core.pipeline import PipelineStage
+    m, df, x = _fit_model(rng)
+    p0 = np.asarray(m.transform(df)["probability"])
+    m.set("binnedScoring", True)
+    m.save(str(tmp_path / "m"))
+    loaded = PipelineStage.load(str(tmp_path / "m"))
+    assert loaded.bin_mapper is not None
+    assert loaded.get("binnedScoring") is True
+    np.testing.assert_array_equal(
+        p0, np.asarray(loaded.transform(df)["probability"]))
+
+
+def test_model_transform_nan_rows_identical(rng):
+    from mmlspark_tpu.core.dataframe import DataFrame
+    m, df, x = _fit_model(rng)
+    x_nan = x[:300].copy()
+    x_nan[::3, 0] = np.nan
+    dfn = DataFrame({"features": x_nan})
+    m.set("binnedScoring", True)
+    p_binned = np.asarray(m.transform(dfn)["probability"])
+    m.set("binnedScoring", False)
+    p_raw = np.asarray(m.transform(dfn)["probability"])
+    np.testing.assert_array_equal(p_binned, p_raw)
+
+
+def test_model_transform_categorical_falls_back(rng):
+    """Categorical models can't route by bin compare; transform must
+    silently use the raw path and still work."""
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+    n = 2500
+    xc = rng.integers(0, 8, size=n).astype(np.float32)
+    xn = rng.normal(size=(n, 2)).astype(np.float32)
+    x = np.column_stack([xc, xn])
+    y = ((xc % 2 == 0) ^ (xn[:, 0] > 0)).astype(np.float64)
+    df = DataFrame({"features": x, "label": y})
+    m = LightGBMClassifier(numIterations=6, numLeaves=15,
+                           categoricalSlotIndexes=[0]).fit(df)
+    if not m.booster.has_categorical:
+        pytest.skip("fixture produced no categorical splits")
+    out = m.transform(df)
+    p = np.asarray(out["probability"])
+    assert np.isfinite(p).all()
+
+
+def test_model_transform_zero_as_missing_identical(rng):
+    """zeroAsMissing models premap 0.0 -> NaN at fit; the binned
+    scoring gate must apply the same premap (review catch: without it
+    zeros bin normally and route differently than predict_fn)."""
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+    n = 2500
+    x = rng.normal(size=(n, 5)).astype(np.float32)
+    x[rng.random((n, 5)) < 0.15] = 0.0   # plenty of exact zeros
+    y = ((x[:, 0] > 0.3) ^ (x[:, 1] < -0.2)).astype(np.float64)
+    df = DataFrame({"features": x, "label": y})
+    m = LightGBMClassifier(numIterations=8, numLeaves=15,
+                           zeroAsMissing=True).fit(df)
+    assert m.booster.zero_premap_mode == "all_left"
+    m.set("binnedScoring", True)
+    p_binned = np.asarray(m.transform(df)["probability"])
+    m.set("binnedScoring", False)
+    p_raw = np.asarray(m.transform(df)["probability"])
+    np.testing.assert_array_equal(p_binned, p_raw)
+
+
+def test_zero_premap_mode_mixed_is_unsupported(rng):
+    import dataclasses
+    booster, mapper, x, _ = _fit(rng)
+    imported = _import_roundtrip(booster)
+    dt = np.zeros_like(imported.split_feature, dtype=np.int8)
+    internal = imported.split_feature >= 0
+    dt[internal] = np.int8(4 | 2)          # zero-missing, left
+    t, mlist = np.nonzero(internal)
+    dt[t[0], mlist[0]] = np.int8(4)        # one node: zero-missing, right
+    mixed = dataclasses.replace(imported, decision_type=dt)
+    assert mixed.zero_premap_mode == "unsupported"

@@ -303,8 +303,7 @@ def test_onehot_tuning_knobs(monkeypatch, extra, rtol):
                               "1").lstrip("-").isdigit()
     if bad_chunk:
         from mmlspark_tpu.models.gbdt import trainer as trainer_mod
-        monkeypatch.setattr(trainer_mod, "_WARNED_BAD_FORMULATION",
-                            False)
+        monkeypatch.setattr(trainer_mod, "_WARNED_BAD_CHUNK", False)
         with pytest.warns(UserWarning, match="ONEHOT_CHUNK"):
             out = np.asarray(_level_histogram(
                 binned, grad, hess, live, local, 8, 7, 31,
