@@ -285,7 +285,7 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
         choice = "separate"
     else:
         choice = "fused"
-    if choice in ("per_feature", "onehot") and in_shard_map:
+    if choice == "per_feature" and in_shard_map:
         choice = "separate"
 
     if choice == "onehot":
@@ -350,6 +350,11 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
         xs = (bc.reshape(-1, chunk, f), dc.reshape(-1, chunk, 3),
               lc.reshape(-1, chunk))
         acc0 = jnp.zeros((f, b, width * 3), jnp.float32)
+        if in_shard_map:
+            # the scan carry must advertise the same varying axes as
+            # the per-shard data or check_vma rejects the carry update;
+            # folding in a zero-valued data element inherits them
+            acc0 = acc0 + 0.0 * dc.reshape(-1)[0]
         acc, _ = jax.lax.scan(chunk_body, acc0, xs)
         return acc.reshape(f, b, width, 3).transpose(2, 0, 1, 3)
 
@@ -920,8 +925,20 @@ def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
     return _cache_put(
         _BUILDER_CACHE,
         (num_f, total_bins, cfg, mode, mesh, pallas_histogram_enabled(),
-         subtract),
+         subtract, _hist_env_key()),
         build)
+
+
+def _hist_env_key() -> tuple:
+    """Trace-time histogram-formulation env state; every compiled-step/
+    builder cache key must include it or flipping the env vars between
+    fits in one process is silently ignored (review catch: the
+    onehot-under-shard_map parity test compared a cached default step
+    against itself)."""
+    from mmlspark_tpu.core.utils import env_flag
+    return (os.environ.get("MMLSPARK_TPU_HIST_FORMULATION", "").strip(),
+            os.environ.get("MMLSPARK_TPU_ONEHOT_CHUNK", "").strip(),
+            env_flag("MMLSPARK_TPU_ONEHOT_BF16"))
 
 
 def _resolve_metrics(cfg: TrainConfig):
@@ -1120,7 +1137,8 @@ def _get_step_fn(num_f, total_bins, cfg, k, n_valid, mode, mesh):
     cfg = _loop_only_normalized(cfg)
     from mmlspark_tpu.core.utils import env_flag
     key = (num_f, total_bins, cfg, k, n_valid, mode, mesh,
-           pallas_histogram_enabled(), env_flag("MMLSPARK_TPU_HIST_SUB"))
+           pallas_histogram_enabled(), env_flag("MMLSPARK_TPU_HIST_SUB"),
+           _hist_env_key())
     return _cache_put(_CHUNK_CACHE, key,
                       lambda: _make_step_fn(num_f, total_bins, cfg, k,
                                             n_valid, mode, mesh))

@@ -314,3 +314,35 @@ def test_onehot_tuning_knobs(monkeypatch, extra, rtol):
             allow_pallas=False))
     np.testing.assert_array_equal(out[..., 2], ref[..., 2])
     np.testing.assert_allclose(out, ref, rtol=rtol, atol=rtol * 10)
+
+
+@pytest.mark.parametrize("tree_learner,mesh_cfg", [
+    ("voting", dict(dp=8)),
+    ("feature", dict(dp=1, fp=8)),
+])
+def test_onehot_under_shard_map_modes(monkeypatch, tree_learner,
+                                      mesh_cfg):
+    """The onehot formulation is shard_map-safe (the scan carry
+    inherits the per-shard varying axes) so multi-chip training can
+    select it if it wins the TPU microbench."""
+    from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
+    from mmlspark_tpu.ops.binning import BinMapper
+    from mmlspark_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    mesh = create_mesh(MeshConfig(**mesh_cfg))
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(512, 8))
+    logit = 1.5 * x[:, 0] - x[:, 1] + 0.5 * x[:, 2]
+    y = (logit + rng.normal(size=512) * 0.3 > 0).astype(np.float64)
+    mapper = BinMapper.fit(x, max_bin=32)
+    binned = mapper.transform(x)
+    bu = mapper.bin_upper_values(32)
+    cfg = TrainConfig(objective="binary", num_iterations=4, num_leaves=15,
+                      max_depth=4, min_data_in_leaf=5, max_bin=32,
+                      tree_learner=tree_learner, top_k=8)
+    base = train(binned, y, cfg, bin_upper=bu, mesh=mesh)
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_FORMULATION", "onehot")
+    oh = train(binned, y, cfg, bin_upper=bu, mesh=mesh)
+    p0 = np.asarray(base.booster.predict_jit()(x))
+    p1 = np.asarray(oh.booster.predict_jit()(x))
+    np.testing.assert_allclose(p0, p1, rtol=1e-4, atol=1e-4)

@@ -472,3 +472,37 @@ def test_lowering_check_is_not_vacuous():
 
     with pytest.raises(Exception, match="[Uu]nimplemented|[Nn]ot.*implement"):
         _lower_tpu(bad, jnp.zeros((256, 128), jnp.float32))
+
+
+def test_voting_builder_with_onehot_lowers_for_tpu(monkeypatch):
+    """The onehot formulation inside the voting shard_map builder (the
+    multi-chip fallback if Mosaic rejects the Pallas kernel) passes TPU
+    lowering with check_vma on."""
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_FORMULATION", "onehot")
+
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models.gbdt.parallel_modes import (
+        make_build_tree_voting,
+    )
+    from mmlspark_tpu.models.gbdt.trainer import (
+        TrainConfig,
+        _loop_only_normalized,
+    )
+    from mmlspark_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    mesh = create_mesh(MeshConfig(dp=8))
+    cfg = _loop_only_normalized(TrainConfig(
+        objective="binary", num_leaves=15, max_depth=4, max_bin=64,
+        top_k=8))
+    fn = make_build_tree_voting(8, 64, cfg, mesh)
+    n, f = 1024, 8
+    rng = np.random.default_rng(0)
+    args = (jnp.asarray(rng.integers(0, 64, size=(n, f)).astype(np.uint8)),
+            jnp.asarray(rng.normal(size=n).astype(np.float32)),
+            jnp.asarray(rng.uniform(0.1, 1, size=n).astype(np.float32)),
+            jnp.ones(n, jnp.float32),
+            jnp.ones(f, jnp.float32),
+            jnp.int32(15))
+    txt = _lower_tpu(fn, *args)
+    assert "dot" in txt or len(txt) > 1000
