@@ -363,3 +363,39 @@ def test_zero_premap_mode_mixed_is_unsupported(rng):
     dt[t[0], mlist[0]] = np.int8(4)        # one node: zero-missing, right
     mixed = dataclasses.replace(imported, decision_type=dt)
     assert mixed.zero_premap_mode == "unsupported"
+
+
+def test_derived_binning_uint16_tier(rng):
+    """A model with >255 distinct thresholds on one feature pushes the
+    derived binning into the uint16 dtype tier; scoring stays exact."""
+    import dataclasses
+    booster, mapper, x, _ = _fit(rng)
+    imported = _import_roundtrip(booster)
+    # widen feature 0's threshold table artificially: give every
+    # feature-0 node a distinct threshold and synthesize extras by
+    # cloning trees with shifted thresholds
+    tv = np.array(imported.threshold_value, copy=True)
+    sf = imported.split_feature
+    reps = []
+    for shift in np.linspace(-3, 3, 40):
+        t2 = np.array(tv, copy=True)
+        t2[sf == 0] += shift
+        reps.append(dataclasses.replace(imported, threshold_value=t2))
+    big = dataclasses.replace(
+        imported,
+        split_feature=np.concatenate([r.split_feature for r in reps]),
+        threshold_bin=np.concatenate([r.threshold_bin for r in reps]),
+        threshold_value=np.concatenate([r.threshold_value for r in reps]),
+        node_value=np.concatenate([r.node_value for r in reps]),
+        count=np.concatenate([r.count for r in reps]),
+        tree_weights=np.concatenate([r.tree_weights for r in reps]),
+        decision_type=(None if imported.decision_type is None else
+                       np.concatenate([imported.decision_type] * len(reps))))
+    binning, derived = big.derive_binning()
+    if binning.num_bins <= 256:
+        pytest.skip("fixture did not exceed 256 thresholds")
+    xb = binning.transform(x[:500])
+    assert xb.dtype == np.uint16
+    np.testing.assert_array_equal(
+        np.asarray(big.predict_jit()(x[:500])),
+        np.asarray(derived.predict_binned_jit()(xb)))
