@@ -7,7 +7,10 @@ behind the HTTP server, single-row requests — directly comparable to
 the reference's continuous-mode claim (docs/Deploy Models/Overview.md:
 ~1 ms on a cluster).
 
-Prints one JSON line: {"p50_ms", "p99_ms", "model", "backend"}.
+Prints one JSON line: {"p50_ms", "p99_ms" (keep-alive client, TCP_NODELAY —
+the realistic serving client), "p50_ms_new_conn" (fresh TCP connection
+per request, the pre-round-5 methodology), "model", "backend",
+"n_requests"}.
 Run: python tools/bench_serving.py [n_requests] [--cpu]
 """
 
@@ -61,25 +64,51 @@ def main():
     server = ContinuousServingServer(
         Wrapper(), warmup_payload=feats).start()
     try:
-        lat = []
-        for i in range(n_req):
-            row = {f"f{j}": float(v) for j, v in
-                   enumerate(rng.normal(size=f))}
-            body = json.dumps(row).encode()
-            t0 = time.perf_counter()
+        import http.client
+        from urllib.parse import urlparse
+        u = urlparse(server.url)
+        # keep-alive client (realistic serving client; the server talks
+        # HTTP/1.1) and fresh-connection client, both measured
+        def timed(send, reps):
+            out = []
+            for _ in range(reps):
+                row = {f"f{j}": float(v) for j, v in
+                       enumerate(rng.normal(size=f))}
+                body = json.dumps(row).encode()
+                t0 = time.perf_counter()
+                send(body)
+                out.append((time.perf_counter() - t0) * 1e3)
+            return out
+
+        conn = http.client.HTTPConnection(u.hostname, u.port, timeout=10)
+        conn.connect()
+        import socket as _socket
+        conn.sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+
+        def send_keepalive(body):
+            conn.request("POST", u.path, body=body,
+                         headers={"Content-Type": "application/json"})
+            json.loads(conn.getresponse().read())
+
+        def send_fresh(body):
             req = urllib.request.Request(
                 server.url, data=body,
                 headers={"Content-Type": "application/json"})
             with urllib.request.urlopen(req, timeout=10) as r:
                 json.loads(r.read())
-            lat.append((time.perf_counter() - t0) * 1e3)
+
+        lat = timed(send_keepalive, n_req)
+        conn.close()
+        lat_new = timed(send_fresh, max(1, n_req // 3))
     finally:
         server.stop()
     lat.sort()
+    lat_new.sort()
     import jax
     print(json.dumps({
         "p50_ms": round(lat[len(lat) // 2], 3),
         "p99_ms": round(lat[max(0, math.ceil(0.99 * len(lat)) - 1)], 3),
+        "p50_ms_new_conn": round(lat_new[len(lat_new) // 2], 3),
         "model": "LightGBMClassifier 28f x 100 trees x 63 leaves",
         "backend": jax.default_backend(),
         "n_requests": n_req,
