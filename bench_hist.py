@@ -6,8 +6,11 @@ measures the candidate XLA formulations on the current backend so the
 trainer can adopt the winner per hardware:
 
   A. stacked   — one segment_sum over (N*F, 3) rows (reachable only
-                 via MMLSPARK_TPU_HIST_FORMULATION=fused; fails to
-                 compile on the axon TPU stack)
+                 via MMLSPARK_TPU_HIST_FORMULATION=fused; HTTP-500ed
+                 on the axon remote compiler in window 1, but that run
+                 predates the argument-passing fix below, so the
+                 failure may have been constant-folding of closure
+                 constants, not the formulation)
   B. separate  — three scalar segment_sums sharing the index vector
                  (trainer default under shard_map on TPU)
   C. per-feat  — fori_loop over features, (N, 3) segments each
@@ -37,8 +40,8 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
-    args = [a for a in sys.argv[1:] if not a.startswith("--")]
-    n = int(args[0]) if args else 2_000_000
+    cli_args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n = int(cli_args[0]) if cli_args else 2_000_000
     f, b, width = 28, 255, 32
     rng = np.random.default_rng(0)
     binned = jnp.asarray(rng.integers(0, b, size=(n, f), dtype=np.int32)
@@ -48,12 +51,12 @@ def main():
     live = jnp.asarray((rng.random(n) < 0.9).astype(np.float32))
     local = jnp.asarray(rng.integers(0, width, size=n, dtype=np.int32))
 
-    def idx_flat():
+    def idx_flat(binned, local):
         base = (local[:, None] * f + jnp.arange(f)[None, :]) * b
         return (base + binned).reshape(-1)
 
-    def variant_stacked():
-        idx = idx_flat()
+    def variant_stacked(binned, grad, hess, live, local):
+        idx = idx_flat(binned, local)
         data = jnp.stack([
             jnp.broadcast_to((grad * live)[:, None], (n, f)).reshape(-1),
             jnp.broadcast_to((hess * live)[:, None], (n, f)).reshape(-1),
@@ -62,8 +65,8 @@ def main():
         return jax.ops.segment_sum(data, idx,
                                    num_segments=width * f * b)
 
-    def variant_separate():
-        idx = idx_flat()
+    def variant_separate(binned, grad, hess, live, local):
+        idx = idx_flat(binned, local)
         outs = []
         for chan in (grad * live, hess * live, live):
             flat = jnp.broadcast_to(chan[:, None], (n, f)).reshape(-1)
@@ -71,7 +74,7 @@ def main():
                                             num_segments=width * f * b))
         return jnp.stack(outs, axis=-1)
 
-    def variant_per_feature():
+    def variant_per_feature(binned, grad, hess, live, local):
         data = jnp.stack([grad * live, hess * live, live], axis=-1)
 
         def body(fi, acc):
@@ -83,8 +86,8 @@ def main():
         acc = jnp.zeros((width, f, b, 3), jnp.float32)
         return jax.lax.fori_loop(0, f, body, acc)
 
-    def variant_scatter():
-        idx = idx_flat()
+    def variant_scatter(binned, grad, hess, live, local):
+        idx = idx_flat(binned, local)
         data = jnp.stack([
             jnp.broadcast_to((grad * live)[:, None], (n, f)).reshape(-1),
             jnp.broadcast_to((hess * live)[:, None], (n, f)).reshape(-1),
@@ -92,14 +95,26 @@ def main():
         ], axis=-1)
         return jnp.zeros((width * f * b, 3), jnp.float32).at[idx].add(data)
 
-    def variant_pallas():
+    def variant_pallas(binned, grad, hess, live, local):
         from mmlspark_tpu.models.gbdt.hist_pallas import (
             pallas_level_histogram,
         )
         return pallas_level_histogram(binned, grad, hess, live, local,
                                       width, f, b)
 
-    def variant_onehot():
+    def variant_per_feature_unrolled(binned, grad, hess, live, local):
+        # same math as per_feature but as 28 INDEPENDENT segment_sums
+        # (no loop carry): lets XLA schedule/overlap the scatters
+        # instead of serializing them through a fori_loop
+        data = jnp.stack([grad * live, hess * live, live], axis=-1)
+        outs = []
+        for fi in range(f):
+            idx = local * b + binned[:, fi].astype(jnp.int32)
+            outs.append(jax.ops.segment_sum(
+                data, idx, num_segments=width * b).reshape(width, b, 3))
+        return jnp.stack(outs, axis=1)
+
+    def variant_onehot(binned, grad, hess, live, local):
         import os
 
         from mmlspark_tpu.models.gbdt.trainer import _level_histogram
@@ -119,6 +134,7 @@ def main():
     variants = {"pallas": variant_pallas,
                 "onehot": variant_onehot,
                 "per_feature": variant_per_feature,
+                "per_feature_unrolled": variant_per_feature_unrolled,
                 "separate": variant_separate,
                 "stacked": variant_stacked,
                 "scatter": variant_scatter}
@@ -139,14 +155,21 @@ def main():
                           "backend for the requested --only set"}))
         return
     results = {}
+    fn_args = (binned, grad, hess, live, local)
     for name, fn in variants.items():
+        # arrays go in as ARGUMENTS: closure capture would embed them
+        # as jaxpr constants and XLA may then CONSTANT-FOLD the whole
+        # variant at compile time (observed: the unrolled scatters were
+        # folded on CPU, "measuring" a memcpy; most likely also why the
+        # fused/scatter variants broke the remote compile helper in the
+        # first TPU window)
         jitted = jax.jit(fn)
         try:
-            jitted()[0].block_until_ready()  # compile
+            jitted(*fn_args)[0].block_until_ready()  # compile
             reps = 5
             t0 = time.perf_counter()
             for _ in range(reps):
-                out = jitted()
+                out = jitted(*fn_args)
             jax.block_until_ready(out)
             dt = (time.perf_counter() - t0) / reps
         except Exception as e:  # a variant may not lower on a backend

@@ -230,9 +230,11 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
     (N*F, 3) broadcast and wins ~4x on CPU. On the first real TPU
     window (2026-07-31, v5e via axon) it won there too: 5.1 Mrow/s per
     level vs 1.6 for three separate segment_sums, while the fused
-    3-channel stack *failed to compile* on the remote XLA:TPU helper
-    (HTTP 500) — so per_feature is now the default everywhere outside
-    shard_map. Under shard_map the fori_loop carry would need manual
+    3-channel stack failed remote compile (HTTP 500; possibly an
+    artifact of the then-buggy bench harness jitting closure-captured
+    inputs as constants — the next window's argument-passing benches
+    decide) — so per_feature, the fastest measured variant, is the
+    default everywhere outside shard_map. Under shard_map the fori_loop carry would need manual
     varying-axes casts, so those callers use the separate formulation
     on TPU and keep the fused scatter on CPU (the long-tested path).
     MMLSPARK_TPU_HIST_FORMULATION=per_feature|separate|fused|onehot

@@ -87,6 +87,7 @@ if [ "$REHEARSAL" = "1" ]; then HN=100000; else HN=2000000; fi
 run hist_pallas 600 python bench_hist.py $HN $CPU --only=pallas
 run_xfail hist_onehot 600 python bench_hist.py $HN $CPU --only=onehot
 run hist_xla 900 python bench_hist.py $HN $CPU --only=per_feature,separate,stacked
+run_xfail hist_unrolled 600 python bench_hist.py $HN $CPU --only=per_feature_unrolled
 run_xfail hist_scatter 600 python bench_hist.py $HN $CPU --only=scatter
 # if onehot wins the microbench, this measures it end-to-end
 MMLSPARK_TPU_HIST_FORMULATION=onehot run_xfail bench_onehot 1500 python bench.py
