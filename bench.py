@@ -155,12 +155,25 @@ def main():
     suffix = "_cpu_fallback" if on_cpu and not intended_cpu else ""
     if n != 2_000_000 or num_trees != 100:
         suffix += f"_rows{n}_trees{num_trees}"
+    # kernel attribution (the r4->r5 regression was unattributable from
+    # the artifact alone): the resolved histogram formulation, the
+    # subtraction default, and whether the native library actually
+    # loaded — a throughput swing between rounds must be explainable
+    # from these fields without rerunning anything
+    from mmlspark_tpu.models.gbdt.trainer import (
+        native_histogram_available,
+        resolve_histogram_formulation,
+        resolve_subtract,
+    )
     print(json.dumps({
         "metric": "gbdt_fit_throughput_higgs28f_2M" + suffix,
         "value": round(row_trees_per_s, 3),
         "unit": "Mrow-trees/s",
         "vs_baseline": round(row_trees_per_s / BASELINE_MROW_TREES_S, 3),
         "backend": jax.default_backend(),
+        "hist_formulation": resolve_histogram_formulation(255, warn=False),
+        "hist_subtract": resolve_subtract("serial", 255),
+        "native_hist_available": native_histogram_available(),
     }))
 
 

@@ -125,6 +125,14 @@ def main():
         finally:
             os.environ.pop("MMLSPARK_TPU_HIST_FORMULATION", None)
 
+    def variant_native(binned, grad, hess, live, local):
+        # the cache-blocked C++ kernel through the same pure_callback
+        # the trainer dispatches (CPU-backend default)
+        from mmlspark_tpu.models.gbdt.trainer import (
+            _native_level_histogram)
+        return _native_level_histogram(binned, grad, hess, live, local,
+                                       width, f, b)
+
     # Order = measurement priority: the 2026-07-31 TPU window died
     # mid-run, so the most decision-relevant variants go first (pallas
     # had never been Mosaic-compiled; scatter hung in remote compile
@@ -132,6 +140,7 @@ def main():
     # later variant in the same process, so tpu_day.sh runs subsets in
     # separately-timeboxed steps via --only=name1,name2.
     variants = {"pallas": variant_pallas,
+                "native": variant_native,
                 "onehot": variant_onehot,
                 "per_feature": variant_per_feature,
                 "per_feature_unrolled": variant_per_feature_unrolled,
@@ -150,6 +159,10 @@ def main():
     if jax.default_backend() != "tpu" and "pallas" in variants:
         # interpret-mode pallas at bench scale is not a measurement
         variants.pop("pallas")
+    if jax.default_backend() == "tpu" and "native" in variants and not only:
+        # a host callback on TPU measures PCIe transfer, not the
+        # kernel; don't burn TPU-window time on it unless asked
+        variants.pop("native")
     if not variants:
         print(json.dumps({"note": "no runnable variants on this "
                           "backend for the requested --only set"}))

@@ -32,7 +32,13 @@ def force_cpu_devices(n_devices: int) -> None:
 
     try:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", n_devices)
+        try:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+        except AttributeError:
+            # older jax: no such config option — the XLA_FLAGS value
+            # set above is the only (and sufficient) mechanism, as long
+            # as no backend initialized before this call
+            pass
     except RuntimeError as e:
         # Backends already initialized — fine only if they already satisfy
         # the request.

@@ -95,7 +95,10 @@ class BoosterArrays:
         (``predict_binned_fn``'s raise-paths and the model-level
         ``binnedScoring`` gate both use it): numerical-only routing and
         valid bin thresholds. Cached — the (T, M) scan is constant per
-        booster and transform runs in serving loops."""
+        booster and transform runs in serving loops. The memoized
+        verdict (like ``zero_premap_mode``'s) assumes the arrays are
+        immutable after construction: derive modified boosters with
+        ``dataclasses.replace``, never by mutating in place."""
         cached = self.__dict__.get("_supports_binned")
         if cached is None:
             cached = (not self.has_categorical

@@ -148,15 +148,15 @@ def _pallas_level_histogram(binned, grad, hess, live, local, *, width: int,
     # parallel_modes._check_vma): interpret discharges the kernel body
     # into the manual trace, where kernel-internal constants trip the
     # checker.
-    vma = frozenset()
-    for operand in (binned, grad, hess, live, local):
-        vma = vma | getattr(jax.typeof(operand), "vma", frozenset())
+    from mmlspark_tpu.core.jax_compat import (operand_vma,
+                                              shape_dtype_struct)
+    vma = operand_vma(binned, grad, hess, live, local)
     kernel = functools.partial(_hist_kernel, num_features=f,
                                bin_pad=_BIN_PAD)
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((width, f, _SPAD, _BIN_PAD),
-                                       jnp.float32, vma=vma),
+        out_shape=shape_dtype_struct((width, f, _SPAD, _BIN_PAD),
+                                     jnp.float32, vma=vma),
         grid_spec=grid_spec,
         interpret=interpret,
     )(block_node, bins_pad, data)

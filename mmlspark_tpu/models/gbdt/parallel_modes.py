@@ -70,23 +70,40 @@ def _split_gains(hist, leaf_objective, cfg, b):
     return jnp.where(ok, gain, -jnp.inf), cum
 
 
-def _check_vma() -> bool:
-    """shard_map's static varying-axes checker, on by default. The
-    pallas histogram kernel's INTERPRET-mode discharge creates
-    constants inside the manual trace that the checker refuses to mix
-    with dp-varying refs (a checker limitation, not a correctness
-    issue — jax's own error message recommends this switch), so the
-    builders turn it off exactly when that kernel is opted in AND the
-    backend will interpret it (non-TPU). On TPU the kernel lowers
-    opaquely through Mosaic with its output vma declared, so the
-    checker stays on for the production path."""
+def _check_vma(total_bins: int) -> bool:
+    """shard_map's static varying-axes checker, on by default. Two
+    histogram backends defeat it (checker limitations, not correctness
+    issues — jax's own error message recommends this switch):
+
+    - the pallas kernel's INTERPRET-mode discharge creates constants
+      inside the manual trace that the checker refuses to mix with
+      dp-varying refs, so the builders turn it off exactly when that
+      kernel is opted in AND the backend will interpret it (non-TPU);
+      on TPU the kernel lowers opaquely through Mosaic with its output
+      vma declared, so the checker stays on for the production path —
+      on vma-typed jax only: 0.4.x's check_rep has no replication rule
+      for pallas_call at all (compiled or interpreted), so there the
+      checker is off whenever the pallas kernel is selected;
+    - the native CPU kernel is a host callback whose result the
+      checker may treat as axis-invariant even though each shard
+      computes its own local histogram (and on 0.4.x the raw-callback
+      primitive has no replication rule either); the psum on the
+      returned histogram still executes either way.
+    """
     import jax
 
     from mmlspark_tpu.core.utils import env_flag
-    from mmlspark_tpu.models.gbdt.hist_pallas import (
-        pallas_histogram_enabled)
-    return not (pallas_histogram_enabled()
-                and jax.default_backend() != "tpu"
+    from mmlspark_tpu.models.gbdt.trainer import (
+        resolve_histogram_formulation)
+    choice = resolve_histogram_formulation(total_bins, in_shard_map=True,
+                                           warn=False)
+    if choice == "native":
+        return False
+    if choice != "pallas":
+        return True
+    if not hasattr(jax, "typeof"):
+        return False
+    return not (jax.default_backend() != "tpu"
                 and not env_flag("MMLSPARK_TPU_PALLAS_FORCE_COMPILE"))
 
 
@@ -109,8 +126,9 @@ def make_build_tree_voting(num_features: int, total_bins: int, cfg,
     remaining_leaves) with ROW-SHARDED binned/grad/hess/valid."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from mmlspark_tpu.core.jax_compat import shard_map
 
     depth = cfg.effective_depth
     num_slots = 2 ** (depth + 1) - 1
@@ -225,7 +243,7 @@ def make_build_tree_voting(num_features: int, total_bins: int, cfg,
         local_fn, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), row, row, row, P(), P()),
         out_specs=(P(), P(), P(), P()),
-        check_vma=_check_vma())
+        check_vma=_check_vma(total_bins))
 
 
 def make_build_tree_feature_parallel(num_features: int, total_bins: int,
@@ -234,8 +252,9 @@ def make_build_tree_feature_parallel(num_features: int, total_bins: int,
     feat_mask are FEATURE-SHARDED, rows replicated."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from mmlspark_tpu.core.jax_compat import pcast_varying, shard_map
 
     depth = cfg.effective_depth
     num_slots = 2 ** (depth + 1) - 1
@@ -269,20 +288,20 @@ def make_build_tree_feature_parallel(num_features: int, total_bins: int,
         remaining = remaining_leaves - 1
 
         # row state must be fp-varying for the routing psum trick
-        node = jax.lax.pcast(node, (FEATURE_AXIS,), to='varying')
-        done = jax.lax.pcast(done, (FEATURE_AXIS,), to='varying')
+        node = pcast_varying(node, (FEATURE_AXIS,))
+        done = pcast_varying(done, (FEATURE_AXIS,))
 
         for d in range(depth):
             level_start = 2 ** d - 1
             width = 2 ** d
             local = jnp.clip(node - level_start, 0, width - 1)
-            live = (~done).astype(grad.dtype) * jax.lax.pcast(
-                valid, (FEATURE_AXIS,), to="varying")
+            live = (~done).astype(grad.dtype) * pcast_varying(
+                valid, (FEATURE_AXIS,))
 
             hist = _histogram(
                 binned_loc,
-                jax.lax.pcast(grad, (FEATURE_AXIS,), to="varying"),
-                jax.lax.pcast(hess, (FEATURE_AXIS,), to="varying"),
+                pcast_varying(grad, (FEATURE_AXIS,)),
+                pcast_varying(hess, (FEATURE_AXIS,)),
                 live, local, width, f_loc, b)
 
             gain, cum = _split_gains(hist, leaf_objective, cfg, b)
@@ -369,4 +388,4 @@ def make_build_tree_feature_parallel(num_features: int, total_bins: int,
         in_specs=(P(None, FEATURE_AXIS), P(), P(), P(), P(FEATURE_AXIS),
                   P()),
         out_specs=(P(), P(), P(), P()),
-        check_vma=_check_vma())
+        check_vma=_check_vma(total_bins))

@@ -217,41 +217,294 @@ def _objective_kwargs(cfg: TrainConfig) -> Dict[str, Any]:
 
 _WARNED_BAD_FORMULATION = False
 _WARNED_BAD_CHUNK = False
+_WARNED_SHARD_DOWNGRADE = False
+_WARNED_NATIVE_DOWNGRADE = False
+
+_VALID_FORMULATIONS = ("per_feature", "separate", "fused", "onehot",
+                       "native")
+
+
+def native_histogram_available() -> bool:
+    """Is the C++ level-histogram kernel loadable (builds lazily)?"""
+    from mmlspark_tpu.native import bindings
+    return bindings.is_available()
+
+
+def _native_hist_default_enabled() -> bool:
+    """Native kernel as the DEFAULT formulation: CPU backend only (on
+    TPU the data never visits the host; under GSPMD the callback is not
+    partitionable — callers gate that via ``allow_native``), only when
+    the compiled library actually loaded (the numpy fallback is for
+    correctness tests, not a default), and — on jax versions where the
+    op goes through ``jax.pure_callback`` instead of the raw-callback
+    primitive — only when synchronous CPU dispatch is guaranteed
+    (pure_callback's impl issues jax dispatches on the callback
+    thread, which deadlock against in-flight executions;
+    ensure_sync_cpu_dispatch's docstring has the full story).
+    MMLSPARK_TPU_NATIVE_HIST=0 is the kill switch back to the XLA
+    formulations."""
+    v = os.environ.get("MMLSPARK_TPU_NATIVE_HIST", "").strip().lower()
+    if v in ("0", "false", "off", "no"):
+        return False
+    if not _raw_callback_needed():
+        from mmlspark_tpu.core.jax_compat import ensure_sync_cpu_dispatch
+        if not ensure_sync_cpu_dispatch():
+            return False
+    import jax
+    return jax.default_backend() == "cpu" and native_histogram_available()
+
+
+def resolve_histogram_formulation(b: int, in_shard_map: bool = False,
+                                  allow_pallas: bool = True,
+                                  allow_native: bool = True,
+                                  warn: bool = True) -> str:
+    """Single best-available histogram-kernel policy, shared by the
+    trainer dispatch, the shard_map builders and bench attribution:
+
+      1. the Pallas TPU kernel when opted in (MMLSPARK_TPU_PALLAS_HIST,
+         pending the on-TPU A/B that may make it the TPU default) and
+         the caller allows it (single-program or per-shard, <=256 bins);
+      2. an explicit MMLSPARK_TPU_HIST_FORMULATION override, with
+         constraint downgrades warned once per process so A/B labels
+         stay honest: per_feature -> separate inside shard_map (the
+         fori_loop carry is not shard_map-safe), native -> XLA default
+         under GSPMD auto-partitioning (host callbacks cannot be
+         partitioned);
+      3. the native cache-blocked C++ kernel on the CPU backend
+         (mmls_level_hist_*, via a host callback) — the competitive
+         CPU path, also selected per-shard inside the explicit
+         shard_map tree learners;
+      4. the XLA segment_sum formulations otherwise: per_feature
+         outside shard_map, separate under shard_map on TPU (fused does
+         not compile there), fused under shard_map on CPU.
+    """
+    import jax
+
+    from mmlspark_tpu.models.gbdt.hist_pallas import (
+        pallas_histogram_enabled,
+    )
+
+    global _WARNED_BAD_FORMULATION, _WARNED_SHARD_DOWNGRADE, \
+        _WARNED_NATIVE_DOWNGRADE
+    if pallas_histogram_enabled() and allow_pallas and b <= 256:
+        return "pallas"
+    forced = os.environ.get("MMLSPARK_TPU_HIST_FORMULATION", "").strip()
+    if forced and forced not in _VALID_FORMULATIONS:
+        # a mistyped value silently running the default would mislabel
+        # an A/B measurement — warn loudly (once per process)
+        if warn and not _WARNED_BAD_FORMULATION:
+            _WARNED_BAD_FORMULATION = True
+            import warnings
+            warnings.warn(
+                f"MMLSPARK_TPU_HIST_FORMULATION={forced!r} is not one "
+                "of per_feature|separate|fused|onehot|native; using the "
+                "default formulation instead", stacklevel=2)
+        forced = ""
+    if forced == "native" and not allow_native:
+        if warn and not _WARNED_NATIVE_DOWNGRADE:
+            _WARNED_NATIVE_DOWNGRADE = True
+            import warnings
+            warnings.warn(
+                "MMLSPARK_TPU_HIST_FORMULATION=native cannot run under "
+                "GSPMD auto-partitioning (host callbacks are not "
+                "partitionable); this builder uses the XLA default — "
+                "label A/B measurements accordingly", stacklevel=2)
+        forced = ""
+    if forced == "per_feature" and in_shard_map:
+        # ADVICE r5: this downgrade used to be silent while mistyped
+        # values warned loudly — inconsistent for A/B labeling
+        if warn and not _WARNED_SHARD_DOWNGRADE:
+            _WARNED_SHARD_DOWNGRADE = True
+            import warnings
+            warnings.warn(
+                "MMLSPARK_TPU_HIST_FORMULATION=per_feature is not "
+                "shard_map-safe (fori_loop carry); running the "
+                "'separate' formulation inside shard_map — label A/B "
+                "measurements accordingly", stacklevel=2)
+        forced = "separate"
+    if forced:
+        return forced
+    if allow_native and _native_hist_default_enabled():
+        return "native"
+    if not in_shard_map:
+        return "per_feature"
+    return "separate" if jax.default_backend() == "tpu" else "fused"
+
+
+_WARNED_ASYNC_CALLBACK = False
+
+
+def _warn_async_callback_hazard() -> None:
+    """A forced ``native`` formulation is honored even when synchronous
+    CPU dispatch could not be guaranteed (parity tests run tiny arrays
+    and are safe), but at >~1 MB operands the callback WILL deadlock —
+    say so once instead of hanging silently."""
+    from mmlspark_tpu.core.jax_compat import ensure_sync_cpu_dispatch
+    global _WARNED_ASYNC_CALLBACK
+    if ensure_sync_cpu_dispatch() or _WARNED_ASYNC_CALLBACK:
+        return
+    _WARNED_ASYNC_CALLBACK = True
+    import warnings
+    warnings.warn(
+        "the native histogram callback is running under asynchronous "
+        "XLA:CPU dispatch (the CPU client was created before "
+        "mmlspark_tpu could disable it, or "
+        "MMLSPARK_TPU_SYNC_CPU_DISPATCH=0 is set); executions over "
+        ">~1 MB operands will deadlock — import mmlspark_tpu before "
+        "running any jax computation", stacklevel=2)
+
+
+_NATIVE_HIST_PRIM = None
+
+
+def _native_hist_primitive():
+    """Raw-callback primitive for the native histogram on jax 0.4.x.
+
+    ``jax.pure_callback`` is NOT usable for this op there: its
+    compiled-mode lowering routes every invocation through
+    ``pure_callback_impl``, which ``jax.device_put``s the operands and
+    ``np.asarray``s them ON THE CALLBACK THREAD — jax dispatches
+    issued while the main thread is blocked inside the very execution
+    the callback is serving. On the single-stream XLA:CPU runtime
+    that circular wait deadlocks: reproduced with the cached training
+    step's second execution at bench shape (2M rows; the first,
+    compile-carrying execution survives — the hang is
+    scheduling-dependent, which is worse than deterministic).
+
+    ``mlir.emit_python_callback`` — the layer pure_callback itself
+    lowers through — hands the callback raw numpy views of the
+    runtime buffers instead: no jax ops on the callback thread,
+    nothing to deadlock, and none of pure_callback_impl's round-trip
+    copies (~2x cheaper per call at 2M rows)."""
+    global _NATIVE_HIST_PRIM
+    if _NATIVE_HIST_PRIM is not None:
+        return _NATIVE_HIST_PRIM
+    import jax.numpy as jnp
+    from jax._src import core as jcore
+    from jax._src.interpreters import mlir as jmlir
+
+    prim = jcore.Primitive("mmlspark_native_level_hist")
+
+    def _run(bn, g, h, lv, lo, width, n_bins):
+        from mmlspark_tpu.native import bindings
+        return bindings.level_histogram(bn, g, h, lv, lo, width, n_bins)
+
+    def _abstract(binned, grad, hess, live, local, *, width, n_bins):
+        return jcore.ShapedArray((width, binned.shape[1], n_bins, 3),
+                                 np.float32)
+
+    def _impl(binned, grad, hess, live, local, *, width, n_bins):
+        # eager (outside-jit) path
+        return jnp.asarray(_run(np.asarray(binned), np.asarray(grad),
+                                np.asarray(hess), np.asarray(live),
+                                np.asarray(local), width, n_bins))
+
+    def _lowering(ctx, *args, width, n_bins):
+        def _cb(bn, g, h, lv, lo):
+            return (_run(bn, g, h, lv, lo, width, n_bins),)
+        result, _, _ = jmlir.emit_python_callback(
+            ctx, _cb, None, list(args), ctx.avals_in, ctx.avals_out,
+            has_side_effect=False)
+        return result
+
+    prim.def_abstract_eval(_abstract)
+    prim.def_impl(_impl)
+    jmlir.register_lowering(prim, _lowering)
+    _NATIVE_HIST_PRIM = prim
+    return prim
+
+
+def _raw_callback_needed() -> bool:
+    """jax 0.4.x needs the raw-callback primitive (see
+    ``_native_hist_primitive``); 0.5+ reworked the callback runtime
+    and carries the vma-typed avals the pure_callback path declares."""
+    import jax
+    major, minor = jax.__version__.split(".")[:2]
+    return (int(major), int(minor)) < (0, 5)
+
+
+def _native_level_histogram(binned, grad, hess, live, local, width, f, b):
+    """The C++ cache-blocked level-histogram kernel
+    (native/data_plane.cpp mmls_level_hist_*) as a host callback: the
+    CPU-backend twin of the Pallas kernel's VMEM restructuring. Inside
+    jit on the CPU backend the buffers are already host-resident, so
+    the callback costs one (width, F, B, 3) result copy. Falls back to
+    a numpy bincount implementation when the library isn't built
+    (bindings.level_histogram), so the formulation stays selectable in
+    compiler-less environments."""
+    import jax
+    import jax.numpy as jnp
+
+    if _raw_callback_needed():
+        return _native_hist_primitive().bind(
+            binned, grad, hess, live, local.astype(jnp.int32),
+            width=width, n_bins=b)
+
+    # the pure_callback path is only safe under synchronous CPU
+    # dispatch (see _native_hist_primitive / ensure_sync_cpu_dispatch)
+    _warn_async_callback_hazard()
+
+    def _cb(bn, g, h, lv, lo, _w=width, _b=b):
+        from mmlspark_tpu.native import bindings
+        return bindings.level_histogram(np.asarray(bn), np.asarray(g),
+                                        np.asarray(h), np.asarray(lv),
+                                        np.asarray(lo), _w, _b)
+
+    # under shard_map the per-shard result varies over whatever mesh
+    # axes the inputs vary over; declare the union when this jax
+    # exposes vma-typed avals (mirrors hist_pallas's out_shape; on
+    # older jax the shard_map builders run with check_vma off instead,
+    # see parallel_modes._check_vma)
+    from mmlspark_tpu.core.jax_compat import (operand_vma,
+                                              shape_dtype_struct)
+    out_type = shape_dtype_struct(
+        (width, f, b, 3), jnp.float32,
+        vma=operand_vma(binned, grad, hess, live, local))
+    return jax.pure_callback(_cb, out_type, binned, grad, hess, live,
+                             local.astype(jnp.int32))
 
 
 def _level_histogram(binned, grad, hess, live, local, width, f, b,
                      in_shard_map: bool = False,
-                     allow_pallas: bool = True):
+                     allow_pallas: bool = True,
+                     allow_native: bool = True,
+                     formulation: Optional[str] = None):
     """Per-level histogram: (N, F) bins + per-row stats ->
     (width, F, B, 3) grad/hess/count sums.
 
-    Formulations, chosen per backend (bench_hist.py measures them):
-    a fori_loop of per-feature segment_sums avoids materializing the
-    (N*F, 3) broadcast and wins ~4x on CPU. On the first real TPU
-    window (2026-07-31, v5e via axon) it won there too: 5.1 Mrow/s per
-    level vs 1.6 for three separate segment_sums, while the fused
-    3-channel stack failed remote compile (HTTP 500; possibly an
+    ``formulation`` pins a pre-resolved choice (the serial builder
+    resolves once per build so its subtraction strategy and histogram
+    backend agree); otherwise ``resolve_histogram_formulation`` picks
+    the best available kernel for this backend/caller.
+
+    XLA formulation notes (bench_hist.py measures them): a fori_loop of
+    per-feature segment_sums avoids materializing the (N*F, 3)
+    broadcast and wins ~4x on CPU over the fused scatter. On the first
+    real TPU window (2026-07-31, v5e via axon) it won there too: 5.1
+    Mrow/s per level vs 1.6 for three separate segment_sums, while the
+    fused 3-channel stack failed remote compile (HTTP 500; possibly an
     artifact of the then-buggy bench harness jitting closure-captured
     inputs as constants — the next window's argument-passing benches
-    decide) — so per_feature, the fastest measured variant, is the
-    default everywhere outside shard_map. Under shard_map the fori_loop carry would need manual
-    varying-axes casts, so those callers use the separate formulation
-    on TPU and keep the fused scatter on CPU (the long-tested path).
-    MMLSPARK_TPU_HIST_FORMULATION=per_feature|separate|fused|onehot
-    overrides (onehot: chunked MXU one-hot contraction, insurance for
-    the Pallas kernel).
+    decide) — so per_feature is the XLA default outside shard_map.
+    Under shard_map the fori_loop carry would need manual varying-axes
+    casts, so those callers use the separate formulation on TPU and
+    keep the fused scatter on CPU (the long-tested path). onehot is the
+    chunked MXU one-hot contraction, insurance for the Pallas kernel.
     """
     import jax
     import jax.numpy as jnp
 
     from mmlspark_tpu.models.gbdt.hist_pallas import (
-        pallas_histogram_enabled,
         pallas_level_histogram,
     )
 
-    if pallas_histogram_enabled() and allow_pallas and b <= 256:
-        # opt-in Pallas kernel (hist_pallas.py; bench_hist.py measures
-        # it against the XLA formulations below on each backend). Safe
+    choice = formulation or resolve_histogram_formulation(
+        b, in_shard_map=in_shard_map, allow_pallas=allow_pallas,
+        allow_native=allow_native)
+
+    if choice == "pallas":
+        # Pallas kernel (hist_pallas.py; bench_hist.py measures it
+        # against the XLA formulations below on each backend). Safe
         # per-shard under shard_map too: the kernel only ever sees this
         # program's local rows, and the cross-device psum happens on the
         # returned histogram exactly as for the XLA formulations
@@ -259,36 +512,11 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
         return pallas_level_histogram(binned, grad, hess, live, local,
                                       width, f, b)
 
-    forced = os.environ.get("MMLSPARK_TPU_HIST_FORMULATION", "").strip()
-    if forced and forced not in ("per_feature", "separate", "fused",
-                                 "onehot"):
-        # a mistyped value silently running the default would mislabel
-        # an A/B measurement — warn loudly (once per process)
-        global _WARNED_BAD_FORMULATION
-        if not _WARNED_BAD_FORMULATION:
-            _WARNED_BAD_FORMULATION = True
-            import warnings
-            warnings.warn(
-                f"MMLSPARK_TPU_HIST_FORMULATION={forced!r} is not one "
-                "of per_feature|separate|fused|onehot; using the default "
-                "formulation instead", stacklevel=2)
-        forced = ""
-    # Resolve which formulation runs. per_feature's fori_loop carry is
-    # not shard_map-safe, so under shard_map a per_feature request
-    # (forced or default) degrades to separate on TPU (where fused does
-    # not even compile) and — when explicitly forced — to separate on
-    # CPU too, so an A/B run is never silently mislabeled; the CPU
-    # shard_map *default* stays fused (the long-tested path there).
-    if forced:
-        choice = forced
-    elif not in_shard_map:
-        choice = "per_feature"
-    elif jax.default_backend() == "tpu":
-        choice = "separate"
-    else:
-        choice = "fused"
-    if choice == "per_feature" and in_shard_map:
-        choice = "separate"
+    if choice == "native":
+        # same per-shard story as pallas: the callback sees only this
+        # program's local rows and the psum happens on the result
+        return _native_level_histogram(binned, grad, hess, live, local,
+                                       width, f, b)
 
     if choice == "onehot":
         # MXU formulation in pure XLA (insurance for the Pallas kernel,
@@ -307,6 +535,10 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
         # for counts — while grad/hess pick up bf16 input rounding,
         # ~0.4% relative: an accuracy-vs-speed A/B, not a default).
         n = binned.shape[0]
+        if n == 0:
+            # ADVICE r5: a zero-row level must return a zero histogram,
+            # not ZeroDivisionError from chunk == 0 in the padding math
+            return jnp.zeros((width, f, b, 3), jnp.float32)
         try:
             chunk = int(os.environ.get("MMLSPARK_TPU_ONEHOT_CHUNK",
                                        "4096"))
@@ -399,7 +631,8 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
 
 
 def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
-                    subtract: bool = False, allow_pallas: bool = True):
+                    subtract: bool = False, allow_pallas: bool = True,
+                    allow_native: bool = True):
     """Compile-once tree builder: (binned, grad, hess, valid, feat_mask,
     remaining_leaves) -> (split_feature, threshold_bin, node_value, count,
     decision_type, bin_go_left).
@@ -413,11 +646,16 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
 
     ``subtract=True`` enables LightGBM's histogram-subtraction trick
     (feature_histogram.hpp Subtract): below the root, only the SMALLER
-    child of each split is histogrammed (its rows compacted to a static
-    N/2 buffer via sized nonzero) and the sibling is derived as
+    child of each split is histogrammed and the sibling is derived as
     parent - smaller. Histogram row-work per tree drops from N*D to
-    ~N*(1 + (D-1)/2). Single-program only: the compaction gather is
-    data-dependent, so sharded (GSPMD) builders keep the full pass.
+    ~N*(1 + (D-1)/2). With the native CPU kernel the smaller child is
+    selected by MASKING its sibling's rows out of ``live`` — the kernel
+    skips masked rows before touching their bin row, so masking is the
+    compaction; the XLA formulations instead compact rows to a static
+    N/2 buffer via sized nonzero (a scatter over masked-to-zero rows
+    would still cost full-N work there). Single-program only: the
+    compaction gather is data-dependent, so sharded (GSPMD) builders
+    keep the full pass.
 
     Categorical features (``cfg.categorical_features``) follow LightGBM's
     algorithm (core/schema/Categoricals.scala; LightGBM's
@@ -442,6 +680,13 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
     if cat_feats:
         is_cat_np[list(cat_feats)] = True
     has_cat = bool(is_cat_np.any())
+    # one resolution per builder: the subtraction strategy (masking vs
+    # compaction) and every level's histogram call must agree on the
+    # kernel; the compiled-builder cache is keyed on the same env state
+    hist_formulation = resolve_histogram_formulation(
+        total_bins, in_shard_map=False, allow_pallas=allow_pallas,
+        allow_native=allow_native, warn=False)
+    masked_subtract = subtract and hist_formulation == "native"
     mono_np = np.zeros(num_features, dtype=np.float32)
     if cfg.monotone_constraints:
         if len(cfg.monotone_constraints) > num_features:
@@ -473,14 +718,17 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
         f = num_features
         b = total_bins
         if subtract:
-            # +1 dummy slot: sized-nonzero fill target for the
-            # smaller-child compaction gather
-            n_half = n // 2 + 1
-            binned_pad = jnp.concatenate(
-                [binned, jnp.zeros((1, f), binned.dtype)])
-            grad_pad = jnp.concatenate([grad, jnp.zeros(1, grad.dtype)])
-            hess_pad = jnp.concatenate([hess, jnp.zeros(1, hess.dtype)])
             prev_hist = prev_split = prev_ss = None
+            if not masked_subtract:
+                # +1 dummy slot: sized-nonzero fill target for the
+                # smaller-child compaction gather
+                n_half = n // 2 + 1
+                binned_pad = jnp.concatenate(
+                    [binned, jnp.zeros((1, f), binned.dtype)])
+                grad_pad = jnp.concatenate(
+                    [grad, jnp.zeros(1, grad.dtype)])
+                hess_pad = jnp.concatenate(
+                    [hess, jnp.zeros(1, hess.dtype)])
 
         node = jnp.zeros(n, dtype=jnp.int32)       # slot in full layout
         done = jnp.zeros(n, dtype=jnp.bool_)        # settled in a leaf
@@ -528,15 +776,28 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
                 par_row = local // 2
                 side = (local % 2).astype(jnp.int32)
                 sel = (live > 0) & (side == prev_ss[par_row])
-                idx = jnp.nonzero(sel, size=n_half, fill_value=n)[0]
-                live_pad = jnp.concatenate([live,
-                                            jnp.zeros(1, live.dtype)])
-                local_pad = jnp.concatenate(
-                    [local, jnp.zeros(1, local.dtype)])
-                hist_small = _level_histogram(
-                    binned_pad[idx], grad_pad[idx], hess_pad[idx],
-                    live_pad[idx], local_pad[idx], width, f, b,
-                    allow_pallas=allow_pallas)
+                if masked_subtract:
+                    # native kernel: masked rows are skipped before
+                    # their bin row is read, so zeroing ``live`` on the
+                    # larger sibling IS the compaction — no gather
+                    hist_small = _level_histogram(
+                        binned, grad, hess,
+                        live * sel.astype(live.dtype), local, width, f,
+                        b, allow_pallas=allow_pallas,
+                        allow_native=allow_native,
+                        formulation=hist_formulation)
+                else:
+                    idx = jnp.nonzero(sel, size=n_half, fill_value=n)[0]
+                    live_pad = jnp.concatenate(
+                        [live, jnp.zeros(1, live.dtype)])
+                    local_pad = jnp.concatenate(
+                        [local, jnp.zeros(1, local.dtype)])
+                    hist_small = _level_histogram(
+                        binned_pad[idx], grad_pad[idx], hess_pad[idx],
+                        live_pad[idx], local_pad[idx], width, f, b,
+                        allow_pallas=allow_pallas,
+                        allow_native=allow_native,
+                        formulation=hist_formulation)
                 kids = jnp.arange(width)
                 par_idx = kids // 2
                 is_small = (kids % 2) == prev_ss[par_idx]
@@ -553,7 +814,9 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
             else:
                 hist = _level_histogram(binned, grad, hess, live, local,
                                         width, f, b,
-                                        allow_pallas=allow_pallas)
+                                        allow_pallas=allow_pallas,
+                                        allow_native=allow_native,
+                                        formulation=hist_formulation)
             if subtract:
                 prev_hist = hist
 
@@ -887,13 +1150,15 @@ def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
                 total_bins)
         else:
             # serial builder under a mesh = GSPMD auto-partitioning,
-            # which cannot partition Mosaic kernels ("Please wrap the
-            # call in a shard_map") — the Pallas histogram is only
-            # selectable single-program here; the distributed modes
-            # above run it per-shard inside their explicit shard_maps
+            # which can partition neither Mosaic kernels ("Please wrap
+            # the call in a shard_map") nor host callbacks — the Pallas
+            # and native histograms are only selectable single-program
+            # here; the distributed modes above run them per-shard
+            # inside their explicit shard_maps
             fn = make_build_tree(num_f, total_bins, cfg,
                                  subtract=subtract,
-                                 allow_pallas=mesh is None)
+                                 allow_pallas=mesh is None,
+                                 allow_native=mesh is None)
         return jax.jit(fn)
 
     if mode in ("voting", "feature") and cfg.categorical_features:
@@ -914,14 +1179,7 @@ def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
     from mmlspark_tpu.models.gbdt.hist_pallas import (
         pallas_histogram_enabled,
     )
-    # histogram subtraction needs single-program semantics (the row
-    # compaction is a data-dependent gather); sharded modes keep the
-    # full per-level pass. Opt-in: on CPU the compaction overhead beats
-    # the saved histogram rows (measured 1.287 vs 1.548 Mrow-trees/s at
-    # bench shape, ROUND4_NOTES.md); the TPU pallas kernel's cost is
-    # row-proportional, so re-measure there before defaulting.
-    from mmlspark_tpu.core.utils import env_flag
-    subtract = mode == "serial" and env_flag("MMLSPARK_TPU_HIST_SUB")
+    subtract = resolve_subtract(mode, total_bins, mesh)
     # the histogram backend is chosen at trace time, so it must key the
     # compiled-builder cache or flipping env flags is silently ignored
     return _cache_put(
@@ -931,16 +1189,52 @@ def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
         build)
 
 
+def resolve_subtract(mode: str, total_bins: int, mesh=None) -> bool:
+    """Histogram-subtraction default policy (LightGBM's sibling trick),
+    shared by the builder cache and bench attribution.
+
+    MMLSPARK_TPU_HIST_SUB=1/0 forces it on/off. Unset, subtraction is
+    ON exactly when the serial single-program builder's histogram
+    resolves to the native CPU kernel, whose masked smaller-child pass
+    skips rows instead of compacting them (parity pinned by
+    tests/gbdt/test_hist_native.py; 2.0x fit throughput at bench shape
+    vs the full pass). It stays OFF elsewhere: the XLA compaction
+    gather measured slower than the full pass on CPU (1.287 vs 1.548
+    Mrow-trees/s, ROUND4_NOTES.md), and the pallas kernel's cost is
+    row-proportional but unmeasured on real hardware — re-measure
+    before defaulting there. Sharded modes never subtract (the
+    compaction is data-dependent)."""
+    if mode != "serial":
+        return False
+    raw = os.environ.get("MMLSPARK_TPU_HIST_SUB", "").strip()
+    if raw:
+        from mmlspark_tpu.core.utils import env_flag
+        return env_flag("MMLSPARK_TPU_HIST_SUB")
+    return resolve_histogram_formulation(
+        total_bins, in_shard_map=False, allow_pallas=mesh is None,
+        allow_native=mesh is None, warn=False) == "native"
+
+
 def _hist_env_key() -> tuple:
     """Trace-time histogram-formulation env state; every compiled-step/
     builder cache key must include it or flipping the env vars between
     fits in one process is silently ignored (review catch: the
     onehot-under-shard_map parity test compared a cached default step
     against itself)."""
+    from mmlspark_tpu.core.jax_compat import ensure_sync_cpu_dispatch
     from mmlspark_tpu.core.utils import env_flag
+    # the sync-dispatch guarantee only gates the pure_callback path
+    # (jax >= 0.5); on 0.4.x the raw-callback primitive is used and
+    # probing the guard here would needlessly flip the global flag
+    sync_state = (True if _raw_callback_needed()
+                  else ensure_sync_cpu_dispatch())
     return (os.environ.get("MMLSPARK_TPU_HIST_FORMULATION", "").strip(),
             os.environ.get("MMLSPARK_TPU_ONEHOT_CHUNK", "").strip(),
-            env_flag("MMLSPARK_TPU_ONEHOT_BF16"))
+            env_flag("MMLSPARK_TPU_ONEHOT_BF16"),
+            os.environ.get("MMLSPARK_TPU_HIST_SUB", "").strip(),
+            os.environ.get("MMLSPARK_TPU_NATIVE_HIST", "").strip(),
+            native_histogram_available(),
+            sync_state)
 
 
 def _resolve_metrics(cfg: TrainConfig):
@@ -1163,6 +1457,27 @@ def aot_lower_step(cfg: TrainConfig, n: int, num_f: int,
     cfg = _loop_only_normalized(cfg)
     k = cfg.num_class if cfg.objective in ("multiclass", "softmax",
                                            "multiclassova") else 1
+    # the artifact must represent the TPU-day program: the lowering
+    # host's default backend is cpu, which would otherwise bake the
+    # host-callback native histogram into a "tpu" lowering that the
+    # real TPU run (backend == tpu) never selects
+    prev_native = os.environ.get("MMLSPARK_TPU_NATIVE_HIST")
+    os.environ["MMLSPARK_TPU_NATIVE_HIST"] = "0"
+    try:
+        return _aot_lower_step_inner(cfg, n, num_f, k, platform,
+                                     rows_per_group)
+    finally:
+        if prev_native is None:
+            os.environ.pop("MMLSPARK_TPU_NATIVE_HIST", None)
+        else:
+            os.environ["MMLSPARK_TPU_NATIVE_HIST"] = prev_native
+
+
+def _aot_lower_step_inner(cfg: TrainConfig, n: int, num_f: int, k: int,
+                          platform: str, rows_per_group: int) -> str:
+    import jax
+    import jax.numpy as jnp
+
     step_fn = _get_step_fn(num_f, cfg.max_bin, cfg, k, 0, "serial", None)
     rng = np.random.default_rng(0)
     ones = jnp.ones(n, jnp.float32)

@@ -425,7 +425,8 @@ class _VWBaseLearner(Estimator, _VWParams):
             # stream, weights are pmean-averaged at the pass boundary —
             # the VW spanning-tree allreduce analog
             # (VowpalWabbitSyncSchedule.scala:15-72)
-            from jax import shard_map
+            from mmlspark_tpu.core.jax_compat import (pcast_varying,
+                                                       shard_map)
             from jax.sharding import PartitionSpec as P
 
             from mmlspark_tpu.parallel.mesh import DATA_AXIS, axis_size
@@ -443,8 +444,8 @@ class _VWBaseLearner(Estimator, _VWParams):
             def sharded_pass(w, g2, s, n_acc, bias, t, bi, bv, byy, bw):
                 # mark the replicated carry as device-varying so the scan
                 # carry type stays consistent once batch data flows in
-                w, g2, s, n_acc, bias, t = jax.lax.pcast(
-                    (w, g2, s, n_acc, bias, t), DATA_AXIS, to='varying')
+                w, g2, s, n_acc, bias, t = pcast_varying(
+                    (w, g2, s, n_acc, bias, t), (DATA_AXIS,))
                 w, g2, s, n_acc, bias, t, preds = run(
                     w, g2, s, n_acc, bias, t, bi, bv, byy, bw)
                 w = jax.lax.pmean(w, DATA_AXIS)

@@ -12,10 +12,12 @@ from mmlspark_tpu.native.bindings import (
     bin_matrix,
     ensure_built,
     is_available,
+    level_histogram,
     load_csv,
     load_libsvm,
     murmur3_batch,
 )
 
 __all__ = ["NativeDataPlane", "ensure_built", "is_available",
-           "load_csv", "load_libsvm", "murmur3_batch", "bin_matrix"]
+           "load_csv", "load_libsvm", "murmur3_batch", "bin_matrix",
+           "level_histogram"]

@@ -29,15 +29,20 @@ def ensure_built() -> bool:
             return True
         if _build_failed:
             return False
-        if not os.path.exists(_SO_PATH):
-            try:
-                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                               capture_output=True, timeout=120)
-            except Exception as e:
+        # always run make: it is a no-op when the .so is fresh and
+        # rebuilds when data_plane.cpp is newer (a stale library would
+        # silently miss symbols added since it was built)
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
+        except Exception as e:
+            if not os.path.exists(_SO_PATH):
                 logger.warning("native build failed (%s); using numpy "
                                "fallbacks", e)
                 _build_failed = True
                 return False
+            logger.warning("native rebuild failed (%s); loading the "
+                           "existing library", e)
         try:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError as e:
@@ -76,6 +81,15 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.mmls_libsvm_parse.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_double),
         ctypes.POINTER(ctypes.c_double), i64, i64]
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i32 = ctypes.c_int32
+    for name, binp in (("mmls_level_hist_u8",
+                        ctypes.POINTER(ctypes.c_uint8)),
+                       ("mmls_level_hist_i32", ctypes.POINTER(i32))):
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = [binp, i64, i64, f32p, f32p, f32p,
+                       ctypes.POINTER(i32), i32, i32, f32p]
 
 
 def is_available() -> bool:
@@ -123,6 +137,56 @@ def bin_matrix(vals: np.ndarray, uppers: np.ndarray) -> np.ndarray:
     for j in range(f):
         out[:, j] = np.minimum(
             np.searchsorted(uppers[j], vals[:, j], side="left"), n_bins - 1)
+    return out
+
+
+def level_histogram(binned: np.ndarray, grad: np.ndarray,
+                    hess: np.ndarray, live: np.ndarray,
+                    local: np.ndarray, width: int,
+                    n_bins: int) -> np.ndarray:
+    """GBDT per-level histogram: (n, f) bin ids + per-row stats ->
+    (width, f, n_bins, 3) float32 grad/hess/count sums, accumulated as
+    ``(grad*live, hess*live, live)`` into the row's ``local`` node.
+
+    The cache-blocked C++ kernel when the library is available (row
+    order within a worker chunk, worker chunks merged in order — the
+    float sum order is deterministic for a given thread count); a
+    bincount fallback otherwise. Bin ids must be < ``n_bins`` and
+    ``local`` in [0, width) — the trainer's binning/clipping guarantees
+    both.
+    """
+    n, f = binned.shape
+    grad = np.ascontiguousarray(grad, np.float32)
+    hess = np.ascontiguousarray(hess, np.float32)
+    live = np.ascontiguousarray(live, np.float32)
+    local = np.ascontiguousarray(local, np.int32)
+    if ensure_built():
+        if binned.dtype == np.uint8:
+            binned = np.ascontiguousarray(binned)
+            fn, binp = _lib.mmls_level_hist_u8, ctypes.c_uint8
+        else:
+            binned = np.ascontiguousarray(binned, np.int32)
+            fn, binp = _lib.mmls_level_hist_i32, ctypes.c_int32
+        out = np.empty((width, f, n_bins, 3), np.float32)
+        fn(binned.ctypes.data_as(ctypes.POINTER(binp)), n, f,
+           grad.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+           hess.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+           live.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+           local.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+           width, n_bins,
+           out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return out
+    out = np.zeros((width, f, n_bins, 3), np.float32)
+    if n == 0:
+        return out
+    idx_base = local.astype(np.int64) * n_bins
+    chans = (grad * live, hess * live, live)
+    for j in range(f):
+        idx = idx_base + binned[:, j]
+        for c, w in enumerate(chans):
+            out[:, j, :, c] = np.bincount(
+                idx, weights=w, minlength=width * n_bins
+            ).reshape(width, n_bins).astype(np.float32)
     return out
 
 
@@ -199,3 +263,4 @@ class NativeDataPlane:
     load_libsvm = staticmethod(load_libsvm)
     murmur3_batch = staticmethod(murmur3_batch)
     bin_matrix = staticmethod(bin_matrix)
+    level_histogram = staticmethod(level_histogram)

@@ -99,12 +99,10 @@ def _streamed_attend(q, k, v, out, row_max, row_sum, q_offset, k_offset,
             k_offset + i * block, causal, scale)
         return (out, row_max, row_sum, i + 1), None
 
+    from mmlspark_tpu.core.jax_compat import operand_vma, pcast_varying
     i0 = jnp.asarray(0)
-    vma = frozenset()
-    for operand in (q, k, v, out, row_max, row_sum):
-        vma = vma | getattr(jax.typeof(operand), "vma", frozenset())
-    if vma:
-        i0 = jax.lax.pcast(i0, tuple(sorted(vma)), to="varying")
+    i0 = pcast_varying(
+        i0, tuple(sorted(operand_vma(q, k, v, out, row_max, row_sum))))
     (out, row_max, row_sum, _), _ = jax.lax.scan(
         step, (out, row_max, row_sum, i0), (kb, vb))
     return out, row_max, row_sum
@@ -146,11 +144,8 @@ def blockwise_attention(q, k, v, block_size: int = 512,
     # inside a shard_map (e.g. the Ulysses inner attention) the inputs
     # vary over the sp axis, so the freshly-created accumulators must be
     # promoted to the same varying type or the scan carry mismatches
-    vma = frozenset()
-    for operand in (q, k, v):
-        vma = vma | getattr(jax.typeof(operand), "vma", frozenset())
-    if vma:
-        stats0 = jax.lax.pcast(stats0, tuple(sorted(vma)), to="varying")
+    from mmlspark_tpu.core.jax_compat import operand_vma, pcast_varying
+    stats0 = pcast_varying(stats0, tuple(sorted(operand_vma(q, k, v))))
     init = (jnp.zeros_like(q), *stats0, jnp.asarray(0))
     (out, row_max, row_sum, _), _ = jax.lax.scan(
         step, init, (k_blocks, v_blocks))
@@ -181,8 +176,9 @@ def ring_attention(q, k, v, mesh, causal: bool = False,
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from mmlspark_tpu.core.jax_compat import pcast_varying, shard_map
 
     n = q.shape[1]
     sp = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
@@ -215,9 +211,9 @@ def ring_attention(q, k, v, mesh, causal: bool = False,
 
         # accumulators must be marked sp-varying for the fori_loop carry
         # (they start shard-invariant but the updates differ per shard)
-        stats0 = jax.lax.pcast(
+        stats0 = pcast_varying(
             (jnp.full((b, h, nq), _NEG_INF, qc.dtype),
-             jnp.zeros((b, h, nq), qc.dtype)), (axis_name,), to="varying")
+             jnp.zeros((b, h, nq), qc.dtype)), (axis_name,))
         init = (jnp.zeros_like(qc), *stats0, kc, vc)
         out, row_max, row_sum, _, _ = jax.lax.fori_loop(0, sp, step, init)
         return out / jnp.maximum(row_sum, 1e-30).transpose(0, 2, 1)[..., None]
@@ -233,8 +229,9 @@ def ulysses_attention(q, k, v, mesh, causal: bool = False,
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from mmlspark_tpu.core.jax_compat import pcast_varying, shard_map
 
     b, n, h, d = q.shape
     sp = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]

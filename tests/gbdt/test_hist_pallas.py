@@ -232,8 +232,12 @@ def test_formulation_override_agrees(forced, monkeypatch):
     """MMLSPARK_TPU_HIST_FORMULATION selects each XLA formulation; all
     must produce identical histograms (the separate branch is the
     production default for shard_map on TPU and is otherwise never
-    selected on CPU, so this is its coverage)."""
+    selected on CPU, so this is its coverage). The unforced default on
+    CPU is now the native kernel (pinned to float tolerance in
+    test_hist_native.py), so the exact-equality reference here is the
+    fused scatter."""
     binned, grad, hess, live, local = _case(3000, 5, 31, 8, seed=3)
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_FORMULATION", "fused")
     ref = np.asarray(_level_histogram(binned, grad, hess, live, local,
                                       8, 5, 31, allow_pallas=False))
     monkeypatch.setenv("MMLSPARK_TPU_HIST_FORMULATION", forced)
