@@ -1,0 +1,214 @@
+"""Deterministic fault-injection harness.
+
+The paper's Spark lineage gets failure semantics for free (task retry,
+barrier rendezvous, executor blacklisting); a single-process jax_graft
+engine has to *manufacture* failures to prove its recovery paths work.
+This module provides named injection points that production code threads
+through a ``fault_point()`` call which is zero-overhead when disabled
+(one module-global boolean check, no dict lookup, no lock), and that
+tests arm programmatically (:func:`arm` / :func:`injected`) or via the
+``MMLSPARK_TPU_FAULTS`` environment variable to raise, delay or corrupt
+on the Nth hit.
+
+Injection points are *registered* (``KNOWN_POINTS``) so the fuzzing
+suite can enumerate and arm every one of them
+(tests/fuzzing/registry.py), and a completeness test pins that every
+``fault_point("...")`` call site in the source tree names a registered
+point.
+
+Env interface (for test authors / chaos runs)::
+
+    MMLSPARK_TPU_FAULTS="serving.score:delay:1:0.2,io.http:raise:3"
+
+comma-separated ``point:action[:nth[:param]]`` specs; ``action`` is
+``raise`` | ``delay`` | ``corrupt``, ``nth`` is the 1-based hit that
+triggers (default 1, every hit from there on), ``param`` is the delay
+in seconds for ``delay``. Parsed once at import; call
+:func:`arm_from_env` after changing the variable in-process.
+
+Determinism contract: each point counts its hits process-wide (thread
+safe), so for a deterministic workload the Nth hit is the same
+operation every run — a fit interrupted at hit N and resumed is a
+reproducible experiment, not a flake.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["FaultInjected", "KNOWN_POINTS", "fault_point", "arm",
+           "disarm", "reset", "hits", "injected", "arm_from_env"]
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed ``raise`` fault (default exception)."""
+
+
+# Canonical registry: point name -> where it lives / what arming it
+# simulates. Production call sites must use names listed here.
+KNOWN_POINTS: Dict[str, str] = {
+    "gbdt.train_step": "trainer boosting loop, once per dispatched "
+                       "iteration — a preempted/killed training step",
+    "gbdt.level_hist": "native/numpy level-histogram kernel entry — a "
+                       "wrong or slow histogram from the data plane",
+    "native.callback": "host-callback boundary of the native histogram "
+                       "primitive — a hung or failing C++ callback",
+    "allreduce": "host sync boundaries of cross-replica reductions "
+                 "(trainer metric sync, VW inter-pass weight average)",
+    "serving.score": "ServingServer micro-batch scoring — a slow or "
+                     "failing model under load",
+    "io.http": "outbound HTTP attempt in HTTPTransformer — a flaky "
+               "remote service",
+    "checkpoint.write": "checkpoint persistence — a full disk or "
+                        "failing blob store",
+    "distributed.init": "multi-process rendezvous in distributed_init "
+                        "— a coordinator that is slow to come up",
+}
+
+_VALID_ACTIONS = ("raise", "delay", "corrupt")
+
+
+@dataclass
+class _Armed:
+    action: str
+    nth: int = 1                 # 1-based hit that starts triggering
+    count: Optional[int] = None  # max triggers (None = every hit >= nth)
+    delay_s: float = 0.05
+    exc: Optional[BaseException] = None
+    corrupt: Optional[Callable[[Any], Any]] = None
+    hits: int = 0
+    fired: int = 0
+
+
+_lock = threading.Lock()
+_armed: Dict[str, _Armed] = {}
+_hit_counts: Dict[str, int] = {}
+# fast-path flag: fault_point() reads ONE module global and returns when
+# nothing is armed anywhere, so disarmed production hot paths pay a
+# single attribute load + branch
+_enabled = False
+
+
+def fault_point(name: str, value: Any = None) -> Any:
+    """Declare an injection point; returns ``value`` (possibly corrupted).
+
+    Production code calls this unconditionally; with nothing armed it is
+    one global-boolean check. With a fault armed on ``name``:
+
+      - ``raise``: raises the armed exception (:class:`FaultInjected`
+        by default) on the configured hits;
+      - ``delay``: sleeps ``delay_s`` seconds;
+      - ``corrupt``: passes ``value`` through the armed ``corrupt``
+        callable and returns the result.
+    """
+    if not _enabled:
+        return value
+    return _slow_fault_point(name, value)
+
+
+def _slow_fault_point(name: str, value: Any) -> Any:
+    with _lock:
+        _hit_counts[name] = _hit_counts.get(name, 0) + 1
+        spec = _armed.get(name)
+        if spec is None:
+            return value
+        spec.hits += 1
+        if spec.hits < spec.nth:
+            return value
+        if spec.count is not None and spec.fired >= spec.count:
+            return value
+        spec.fired += 1
+        action, delay_s = spec.action, spec.delay_s
+        exc, corrupt = spec.exc, spec.corrupt
+    # act outside the lock: a delay must not serialize other points
+    if action == "raise":
+        raise exc if exc is not None else FaultInjected(
+            f"injected fault at {name!r} (hit {spec.hits})")
+    if action == "delay":
+        time.sleep(delay_s)
+        return value
+    if action == "corrupt":
+        return corrupt(value) if corrupt is not None else value
+    return value
+
+
+def arm(name: str, action: str = "raise", *, nth: int = 1,
+        count: Optional[int] = 1, delay_s: float = 0.05,
+        exc: Optional[BaseException] = None,
+        corrupt: Optional[Callable[[Any], Any]] = None) -> None:
+    """Arm ``name`` to trigger ``action`` starting at the ``nth`` hit,
+    for at most ``count`` triggers (``None`` = unbounded)."""
+    global _enabled
+    if name not in KNOWN_POINTS:
+        raise ValueError(f"unknown fault point {name!r}; register it in "
+                         f"mmlspark_tpu.core.faults.KNOWN_POINTS "
+                         f"(have: {sorted(KNOWN_POINTS)})")
+    if action not in _VALID_ACTIONS:
+        raise ValueError(f"action must be one of {_VALID_ACTIONS}, "
+                         f"got {action!r}")
+    with _lock:
+        _armed[name] = _Armed(action=action, nth=nth, count=count,
+                              delay_s=delay_s, exc=exc, corrupt=corrupt)
+        _enabled = True
+
+
+def disarm(name: str) -> None:
+    global _enabled
+    with _lock:
+        _armed.pop(name, None)
+        _enabled = bool(_armed)
+
+
+def reset() -> None:
+    """Disarm everything and zero all hit counters."""
+    global _enabled
+    with _lock:
+        _armed.clear()
+        _hit_counts.clear()
+        _enabled = False
+
+
+def hits(name: str) -> int:
+    """Process-wide hit count of a point while any fault was armed
+    (counting is part of the slow path: 0 when nothing was ever armed)."""
+    with _lock:
+        return _hit_counts.get(name, 0)
+
+
+@contextmanager
+def injected(name: str, action: str = "raise", **kwargs):
+    """Scoped :func:`arm`; always disarms on exit (exceptions included),
+    so an armed test fault can never leak into later tests."""
+    arm(name, action, **kwargs)
+    try:
+        yield
+    finally:
+        disarm(name)
+
+
+def arm_from_env(env: Optional[str] = None) -> None:
+    """Parse ``MMLSPARK_TPU_FAULTS`` (or ``env``) and arm the specs in
+    it. Malformed entries raise immediately — a chaos run with a typo'd
+    spec silently doing nothing would report false health."""
+    raw = env if env is not None else os.environ.get(
+        "MMLSPARK_TPU_FAULTS", "")
+    for entry in filter(None, (e.strip() for e in raw.split(","))):
+        parts = entry.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                f"bad MMLSPARK_TPU_FAULTS entry {entry!r}; expected "
+                "point:action[:nth[:param]]")
+        name, action = parts[0], parts[1]
+        nth = int(parts[2]) if len(parts) > 2 else 1
+        kwargs: Dict[str, Any] = {"nth": nth, "count": None}
+        if action == "delay" and len(parts) > 3:
+            kwargs["delay_s"] = float(parts[3])
+        arm(name, action, **kwargs)
+
+
+arm_from_env()

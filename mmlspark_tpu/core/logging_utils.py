@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import logging
 import re
+import threading
 import time
 import traceback
 import uuid
@@ -59,6 +60,34 @@ class TelemetrySink:
 
 
 SINK = TelemetrySink()
+
+_WARNED_ONCE: set = set()
+_WARNED_LOCK = threading.Lock()
+
+
+def warn_once(key: str, message: str, *args: Any) -> bool:
+    """Log a degradation warning exactly once per process (keyed), and
+    record it as a telemetry event so A/B labels stay honest even when
+    the log stream is discarded. Returns True when this call emitted.
+
+    Used by every graceful-degradation path (retry exhaustion,
+    checkpoint skip, serving backpressure, kernel fallbacks) — a long
+    run that silently degrades would otherwise report false health.
+    """
+    with _WARNED_LOCK:
+        if key in _WARNED_ONCE:
+            return False
+        _WARNED_ONCE.add(key)
+    logger.warning(message, *args)
+    SINK.emit({"event": "degradation", "key": key,
+               "message": scrub(message % args if args else message)})
+    return True
+
+
+def reset_warn_once() -> None:
+    """Test hook: forget emitted once-per-process warnings."""
+    with _WARNED_LOCK:
+        _WARNED_ONCE.clear()
 
 
 def new_uid(prefix: str) -> str:

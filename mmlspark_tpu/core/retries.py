@@ -1,0 +1,110 @@
+"""Shared retry/backoff policy for transient-failure boundaries.
+
+One retry implementation (exponential backoff, bounded jitter, overall
+deadline) shared by the outbound-HTTP layer (io/http.py), the cognitive
+transformers (io/cognitive.py) and the multi-process rendezvous
+(parallel/mesh.distributed_init) — the engine analog of the reference's
+``FaultToleranceUtils.retryWithTimeout``
+(core/utils/FaultToleranceUtils.scala:9-31) plus HandlingUtils'
+throttle-aware backoff.
+
+Retry exhaustion is a *degradation*, not just an exception: it logs
+once per process through :func:`logging_utils.warn_once` so long runs
+that quietly fall back don't mislabel A/B measurements.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence, Tuple, Type
+
+from mmlspark_tpu.core.logging_utils import logger, warn_once
+
+__all__ = ["RetryPolicy", "with_retries", "backoff_schedule"]
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """``max_attempts`` total calls (1 = no retries). Delay before retry
+    k (1-based) is ``min(base_delay * multiplier**(k-1), max_delay)``
+    plus up to ``jitter`` fraction of itself, capped so the sum never
+    exceeds ``deadline`` seconds from the first attempt."""
+
+    max_attempts: int = 3
+    base_delay: float = 0.1
+    multiplier: float = 2.0
+    max_delay: float = 5.0
+    jitter: float = 0.1
+    deadline: Optional[float] = None
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        d = min(self.base_delay * self.multiplier ** (attempt - 1),
+                self.max_delay)
+        return d * (1.0 + self.jitter * rng.random())
+
+
+def backoff_schedule(delays: Sequence[float]) -> RetryPolicy:
+    """Adapt an explicit delay list (the ``backoffs`` param surface of
+    the HTTP transformers) onto a policy: attempts = len+1, and
+    ``with_retries`` consults the list verbatim via ``fixed_delays``."""
+    policy = RetryPolicy(max_attempts=len(delays) + 1, jitter=0.0)
+    object.__setattr__(policy, "_fixed", tuple(float(d) for d in delays))
+    return policy
+
+
+def with_retries(fn: Callable, *, policy: Optional[RetryPolicy] = None,
+                 retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+                 should_retry: Optional[Callable[[BaseException], bool]] = None,
+                 describe: str = "operation",
+                 min_delay_override: Optional[
+                     Callable[[BaseException], Optional[float]]] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 seed: Optional[int] = None):
+    """Call ``fn()`` retrying transient failures.
+
+    - ``retry_on``: exception classes eligible for retry;
+    - ``should_retry``: optional refinement over a caught eligible
+      exception (e.g. HTTP status in {429, 5xx});
+    - ``min_delay_override``: per-exception floor on the next delay
+      (Retry-After honoring);
+    - ``seed``: deterministic jitter for tests.
+
+    On exhaustion the last exception re-raises and the degradation is
+    logged once per process (keyed by ``describe``).
+    """
+    policy = policy or RetryPolicy()
+    rng = random.Random(seed)
+    fixed = getattr(policy, "_fixed", None)
+    start = time.monotonic()
+    last: Optional[BaseException] = None
+    for attempt in range(1, max(policy.max_attempts, 1) + 1):
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203 — retry loop by design
+            last = e
+            if should_retry is not None and not should_retry(e):
+                raise
+            if attempt >= policy.max_attempts:
+                break
+            delay = (fixed[attempt - 1] if fixed is not None
+                     else policy.delay(attempt, rng))
+            if min_delay_override is not None:
+                floor = min_delay_override(e)
+                if floor is not None:
+                    delay = max(delay, floor)
+            if policy.deadline is not None:
+                remaining = policy.deadline - (time.monotonic() - start)
+                if remaining <= 0:
+                    break
+                delay = min(delay, remaining)
+            logger.info("%s failed (%s: %s); retry %d/%d in %.2fs",
+                        describe, type(e).__name__, e, attempt,
+                        policy.max_attempts - 1, delay)
+            sleep(delay)
+    warn_once(f"retry.exhausted.{describe}",
+              "%s failed after %d attempts; giving up (last error: %s)",
+              describe, policy.max_attempts, last)
+    assert last is not None
+    raise last

@@ -12,7 +12,7 @@ from __future__ import annotations
 import importlib
 import json
 import os
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -166,3 +166,118 @@ def _json_default(o: Any):
 def _framework_version() -> str:
     import mmlspark_tpu
     return mmlspark_tpu.__version__
+
+
+# ---------------------------------------------------------------------------
+# Crash-safe checkpoints (atomic write-rename, monotonic tag, config hash)
+# ---------------------------------------------------------------------------
+#
+# The training-side recovery protocol shared by the GBDT elastic-restart
+# path (models/gbdt/estimators.py) and the VW learners' pass-boundary
+# snapshots (models/vw/learners.py):
+#
+#   - every file lands via write-to-tmp + os.replace, so readers only
+#     ever see complete files (a SIGKILLed writer leaves a .tmp that is
+#     never picked up);
+#   - a checkpoint is a payload file plus a small JSON manifest written
+#     LAST — the manifest replace is the commit point; a payload with
+#     no manifest is invisible;
+#   - manifests carry a caller-supplied config hash; resuming under a
+#     different config/dataset is refused instead of silently
+#     continuing an incompatible model.
+
+def atomic_write(path: str, data, mode: str = "w") -> None:
+    """Write-then-rename so a crash mid-write never tears ``path``."""
+    from mmlspark_tpu.core.faults import fault_point
+    fault_point("checkpoint.write")
+    tmp = path + ".tmp"
+    with open(tmp, mode) as fh:
+        fh.write(data)
+    os.replace(tmp, path)
+
+
+def save_checkpoint(ckpt_dir: str, tag: int, state: Dict[str, Any],
+                    config_hash: str) -> str:
+    """Persist ``state`` (numpy arrays + JSON-able scalars) as
+    checkpoint ``tag``; returns the manifest path. ``tag`` must be the
+    monotonic progress counter (iteration / pass) — ``load_latest``
+    resumes from the highest committed one."""
+    from mmlspark_tpu.core.faults import fault_point
+    fault_point("checkpoint.write")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    plain: Dict[str, Any] = {}
+    for k, v in state.items():
+        if isinstance(v, np.ndarray) or _is_jax_array(v):
+            arrays[k] = np.asarray(v)
+        else:
+            plain[k] = v
+    stem = os.path.join(ckpt_dir, f"ckpt_{tag:08d}")
+    tmp = stem + ".npz.tmp"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **arrays)
+    os.replace(tmp, stem + ".npz")
+    manifest = {"tag": int(tag), "configHash": config_hash,
+                "plain": plain, "arrayKeys": sorted(arrays),
+                "frameworkVersion": _framework_version()}
+    atomic_write(stem + ".json", json.dumps(manifest, indent=2,
+                                            default=_json_default))
+    return stem + ".json"
+
+
+def load_latest_checkpoint(ckpt_dir: str,
+                           config_hash: Optional[str] = None):
+    """Newest committed checkpoint as ``(tag, state)``; ``None`` when
+    the directory holds none.
+
+    A manifest with a different ``config_hash`` raises ValueError
+    ("different config or dataset") — resuming must never silently
+    continue an incompatible run. A torn or unreadable checkpoint
+    (truncated manifest, missing payload) is skipped with a
+    once-per-process warning and the scan falls back to the previous
+    tag — crash debris degrades recovery depth, not correctness."""
+    import re
+
+    from mmlspark_tpu.core.logging_utils import warn_once
+
+    if not os.path.isdir(ckpt_dir):
+        return None
+    tags = sorted(
+        (int(m.group(1)) for m in (
+            re.fullmatch(r"ckpt_(\d+)\.json", name)
+            for name in os.listdir(ckpt_dir)) if m),
+        reverse=True)
+    for tag in tags:
+        stem = os.path.join(ckpt_dir, f"ckpt_{tag:08d}")
+        try:
+            with open(stem + ".json") as fh:
+                manifest = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            _skip_corrupt(ckpt_dir, stem, e, warn_once)
+            continue
+        if config_hash is not None \
+                and manifest.get("configHash") != config_hash:
+            raise ValueError(
+                f"checkpoint {stem}.json was produced by a "
+                "different config or dataset (hash "
+                f"{manifest.get('configHash')!r} != {config_hash!r});"
+                " clear the directory to train fresh")
+        try:
+            state: Dict[str, Any] = dict(manifest.get("plain") or {})
+            keys = manifest.get("arrayKeys") or []
+            if keys:
+                with np.load(stem + ".npz", allow_pickle=False) as z:
+                    for k in keys:
+                        state[k] = z[k]
+            return int(manifest["tag"]), state
+        except Exception as e:  # missing/torn payload
+            _skip_corrupt(ckpt_dir, stem, e, warn_once)
+    return None
+
+
+def _skip_corrupt(ckpt_dir: str, stem: str, e: BaseException,
+                  warn_once) -> None:
+    warn_once(f"checkpoint.corrupt.{ckpt_dir}",
+              "skipping unreadable checkpoint %s (%s: %s); "
+              "falling back to an earlier one",
+              stem, type(e).__name__, e)

@@ -52,29 +52,38 @@ class CognitiveServiceTransformer(Transformer, HasOutputCol):
     def _open_retrying(self, req):
         """urlopen with the family's transient-error policy: retry
         429/5xx and connection blips with backoff (Retry-After
-        honored), like the sync transformers' HTTP layer (io/http.py)."""
-        import time as _time
+        honored), via the shared :func:`with_retries` policy — the same
+        machinery as the sync transformers' HTTP layer (io/http.py)."""
         import urllib.error
         import urllib.request
 
-        delays = (0.0, 0.2, 1.0)
-        last = None
-        for delay in delays:
-            if delay:
-                _time.sleep(delay)
-            try:
-                return urllib.request.urlopen(req,
-                                              timeout=self.get("timeout"))
-            except urllib.error.HTTPError as e:
-                last = e
-                if e.code != 429 and e.code < 500:
-                    raise
+        from mmlspark_tpu.core.faults import fault_point
+        from mmlspark_tpu.core.retries import backoff_schedule, with_retries
+
+        def attempt():
+            fault_point("io.http")
+            return urllib.request.urlopen(req, timeout=self.get("timeout"))
+
+        def should_retry(e):
+            if isinstance(e, urllib.error.HTTPError):
+                return e.code == 429 or e.code >= 500
+            return isinstance(e, OSError)
+
+        def floor(e):
+            if isinstance(e, urllib.error.HTTPError):
                 retry_after = e.headers.get("Retry-After")
                 if retry_after:
-                    _time.sleep(min(float(retry_after), 5.0))
-            except OSError as e:  # URLError/timeouts/conn resets
-                last = e
-        raise last
+                    try:
+                        return min(float(retry_after), 5.0)
+                    except ValueError:
+                        return None
+            return None
+
+        return with_retries(
+            attempt, policy=backoff_schedule([0.2, 1.0]),
+            retry_on=(urllib.error.HTTPError, OSError),
+            should_retry=should_retry, min_delay_override=floor,
+            describe="cognitive.request")
 
     def _row_parallel(self, dataset, run_one):
         """Run ``run_one(row) -> value`` over all rows with up to

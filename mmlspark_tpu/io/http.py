@@ -16,7 +16,6 @@ structs): request {"url", "method", "headers", "body"}; response
 from __future__ import annotations
 
 import json
-import time
 import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
@@ -25,11 +24,12 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame
-from mmlspark_tpu.core.logging_utils import logger
+from mmlspark_tpu.core.faults import fault_point
 from mmlspark_tpu.core.param import (
     HasInputCol, HasOutputCol, Param, gt, to_float, to_int, to_list, to_str,
 )
 from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.core.retries import backoff_schedule, with_retries
 
 
 class HTTPResponseData(dict):
@@ -44,49 +44,66 @@ class HTTPResponseData(dict):
         return self.get("entity")
 
 
+_RETRYABLE_CODES = (429, 500, 502, 503, 504)
+
+
+def _retry_after_floor(e: BaseException) -> Optional[float]:
+    """Server-suggested minimum wait (HandlingUtils honors Retry-After)."""
+    if isinstance(e, urllib.error.HTTPError):
+        retry_after = e.headers.get("Retry-After")
+        if retry_after:
+            try:
+                return float(retry_after)
+            except ValueError:
+                return None
+    return None
+
+
 def _execute_one(request: Dict[str, Any], timeout: float,
                  backoffs: List[float]) -> HTTPResponseData:
     """One request with advanced-handler retry semantics
-    (HandlingUtils.advancedUDF: retry 429/5xx with backoff)."""
-    attempt = 0
-    while True:
-        try:
-            body = request.get("body")
-            if isinstance(body, str):
-                body = body.encode()
-            req = urllib.request.Request(
-                request["url"], data=body,
-                headers=request.get("headers") or {},
-                method=request.get("method", "POST" if body else "GET"))
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                return HTTPResponseData(
-                    statusCode=resp.status,
-                    reasonPhrase=resp.reason,
-                    headers=dict(resp.headers),
-                    entity=resp.read())
-        except urllib.error.HTTPError as e:
-            if e.code in (429, 500, 502, 503, 504) and attempt < len(backoffs):
-                wait = backoffs[attempt]
-                retry_after = e.headers.get("Retry-After")
-                if retry_after:
-                    try:
-                        wait = max(wait, float(retry_after))
-                    except ValueError:
-                        pass
-                logger.info("HTTP %s; retrying in %.2fs", e.code, wait)
-                time.sleep(wait)
-                attempt += 1
-                continue
-            return HTTPResponseData(statusCode=e.code, reasonPhrase=str(e),
-                                    headers=dict(e.headers or {}),
-                                    entity=e.read() if e.fp else None)
-        except Exception as e:  # connection errors -> synthetic 0 status
-            if attempt < len(backoffs):
-                time.sleep(backoffs[attempt])
-                attempt += 1
-                continue
-            return HTTPResponseData(statusCode=0, reasonPhrase=str(e),
-                                    headers={}, entity=None)
+    (HandlingUtils.advancedUDF: retry 429/5xx and connection blips with
+    backoff), routed through the shared :func:`with_retries` policy.
+    Exhaustion degrades to an error-shaped response row (statusCode 0
+    for connection failures) rather than raising — the error column is
+    the reporting surface."""
+
+    def attempt() -> HTTPResponseData:
+        # injection point: an armed raise/delay here simulates a flaky
+        # or slow remote, exercised per ATTEMPT so retries are visible
+        fault_point("io.http")
+        body = request.get("body")
+        if isinstance(body, str):
+            body = body.encode()
+        req = urllib.request.Request(
+            request["url"], data=body,
+            headers=request.get("headers") or {},
+            method=request.get("method", "POST" if body else "GET"))
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return HTTPResponseData(
+                statusCode=resp.status,
+                reasonPhrase=resp.reason,
+                headers=dict(resp.headers),
+                entity=resp.read())
+
+    def should_retry(e: BaseException) -> bool:
+        if isinstance(e, urllib.error.HTTPError):
+            return e.code in _RETRYABLE_CODES
+        return True  # connection errors / timeouts / injected faults
+
+    try:
+        return with_retries(
+            attempt, policy=backoff_schedule(backoffs),
+            should_retry=should_retry,
+            min_delay_override=_retry_after_floor,
+            describe="http.request")
+    except urllib.error.HTTPError as e:
+        return HTTPResponseData(statusCode=e.code, reasonPhrase=str(e),
+                                headers=dict(e.headers or {}),
+                                entity=e.read() if e.fp else None)
+    except Exception as e:  # connection errors -> synthetic 0 status
+        return HTTPResponseData(statusCode=0, reasonPhrase=str(e),
+                                headers={}, entity=None)
 
 
 class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):

@@ -36,8 +36,59 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame
-from mmlspark_tpu.core.logging_utils import logger
+from mmlspark_tpu.core.faults import fault_point
+from mmlspark_tpu.core.logging_utils import logger, warn_once
 from mmlspark_tpu.core.pipeline import Transformer
+
+
+class _CappedThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a hard cap on concurrent connections.
+
+    HTTP/1.1 keep-alive pins one thread per persistent connection, so
+    without a cap N idle clients hold N threads forever (the unbounded
+    keep-alive growth this fixes). Connections beyond the cap are
+    answered with an immediate ``503 + Retry-After`` and closed — load
+    balancers and :class:`FleetClient` treat that as "try another
+    worker", which is exactly the backpressure contract.
+    """
+
+    daemon_threads = True
+
+    def __init__(self, addr, handler, max_connections: int,
+                 retry_after_s: float = 1.0):
+        super().__init__(addr, handler)
+        self._conn_sem = threading.BoundedSemaphore(max_connections)
+        self._retry_after_s = retry_after_s
+        self.rejected_connections = 0
+
+    def process_request(self, request, client_address):
+        if not self._conn_sem.acquire(blocking=False):
+            self.rejected_connections += 1
+            warn_once(
+                "serving.connection_cap",
+                "serving connection cap reached; rejecting new "
+                "connections with 503 + Retry-After")
+            try:
+                request.sendall(
+                    b"HTTP/1.1 503 Service Unavailable\r\n"
+                    b"Retry-After: " +
+                    str(max(int(self._retry_after_s), 1)).encode() +
+                    b"\r\nConnection: close\r\nContent-Length: 0\r\n\r\n")
+            except OSError:
+                pass
+            self.shutdown_request(request)
+            return
+        try:
+            super().process_request(request, client_address)
+        except BaseException:
+            self._conn_sem.release()
+            raise
+
+    def process_request_thread(self, request, client_address):
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            self._conn_sem.release()
 
 
 class _Pending:
@@ -56,16 +107,30 @@ class ServingServer:
     def __init__(self, model: Transformer, host: str = "127.0.0.1",
                  port: int = 0, reply_col: Optional[str] = None,
                  max_batch_size: int = 64, max_latency_ms: float = 5.0,
-                 api_path: str = "/score"):
+                 api_path: str = "/score", max_queue: int = 256,
+                 request_timeout_s: float = 30.0,
+                 max_connections: int = 64,
+                 idle_timeout_s: float = 15.0,
+                 retry_after_s: float = 1.0):
         self.model = model
         self._keep_id = self._consumes_id_column(model)
         self.reply_col = reply_col
         self.max_batch_size = max_batch_size
         self.max_latency_ms = max_latency_ms
         self.api_path = api_path
+        # backpressure contract: the pending queue is BOUNDED; a full
+        # queue answers 503 + Retry-After instead of queueing without
+        # limit (an overloaded scorer would otherwise accumulate
+        # requests it can never answer within their deadline)
+        self.max_queue = max_queue
+        self.request_timeout_s = request_timeout_s
+        self.retry_after_s = retry_after_s
         self._queue: List[_Pending] = []
         self._lock = threading.Condition()
         self._stop = False
+        self._stats = {"served": 0, "errors": 0, "rejected": 0,
+                       "timeouts": 0}
+        self._last_shed = 0.0  # monotonic time of the last 503
 
         server = self
 
@@ -80,11 +145,28 @@ class ServingServer:
             # the Nagle/delayed-ACK 40 ms stall without this
             disable_nagle_algorithm = True
             # keep-alive must not pin a thread forever on an idle or
-            # half-closed connection
-            timeout = 60
+            # half-closed connection: capped idle timeout (paired with
+            # the _CappedThreadingHTTPServer connection cap)
+            timeout = idle_timeout_s
 
             def log_message(self, fmt, *args):  # quiet
                 pass
+
+            def _reply_json(self, code, obj, extra_headers=None):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply_json(200, server._health())
+                    return
+                self.send_error(404)
 
             def do_POST(self):
                 if self.path != server.api_path:
@@ -103,8 +185,23 @@ class ServingServer:
                     self.send_error(400, f"bad json: {e}")
                     return
                 pending = _Pending(payload)
-                server._enqueue(pending)
-                if not pending.event.wait(timeout=30.0):
+                if not server._enqueue(pending):
+                    # backpressure: bounded queue is full — shed load
+                    # NOW with a retry hint instead of queueing past
+                    # any deadline the client could still meet
+                    self._reply_json(
+                        503, {"error": "server overloaded"},
+                        {"Retry-After":
+                         str(max(int(server.retry_after_s), 1))})
+                    return
+                if not pending.event.wait(
+                        timeout=server.request_timeout_s):
+                    with server._lock:
+                        server._stats["timeouts"] += 1
+                        # a timed-out request still sitting in the
+                        # queue must not consume a scoring slot
+                        if pending in server._queue:
+                            server._queue.remove(pending)
                     self.send_error(504, "scoring timed out")
                     return
                 if pending.error is not None:
@@ -117,17 +214,44 @@ class ServingServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = _CappedThreadingHTTPServer(
+            (host, port), Handler, max_connections=max_connections,
+            retry_after_s=retry_after_s)
         self.host, self.port = self._httpd.server_address
         self._server_thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)
         self._batch_thread = threading.Thread(
             target=self._batch_loop, daemon=True)
 
-    def _enqueue(self, pending: "_Pending") -> None:
+    def _enqueue(self, pending: "_Pending") -> bool:
         with self._lock:
+            if len(self._queue) >= self.max_queue:
+                self._stats["rejected"] += 1
+                self._last_shed = time.monotonic()
+                warn_once(
+                    "serving.backpressure",
+                    "serving queue full (max_queue=%s); shedding load "
+                    "with 503 + Retry-After", self.max_queue)
+                return False
             self._queue.append(pending)
             self._lock.notify()
+            return True
+
+    def _health(self) -> Dict[str, Any]:
+        """/healthz payload: ``degraded`` while the pending queue sits
+        at half capacity or load was shed in the last 5 s — scrapers
+        and fleet registries can steer traffic away before hard 503s
+        dominate, and the flag clears once the backlog drains."""
+        with self._lock:
+            depth = len(self._queue)
+            stats = dict(self._stats)
+            last_shed = self._last_shed
+        degraded = (depth >= max(self.max_queue // 2, 1)
+                    or (last_shed and time.monotonic() - last_shed < 5.0))
+        return {"status": "degraded" if degraded else "ok",
+                "queueDepth": depth, "maxQueue": self.max_queue,
+                "rejectedConnections": getattr(
+                    self._httpd, "rejected_connections", 0), **stats}
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ServingServer":
@@ -171,7 +295,11 @@ class ServingServer:
                 del self._queue[:len(batch)]
             try:
                 self._score(batch)
+                with self._lock:
+                    self._stats["served"] += len(batch)
             except Exception as e:  # surface scoring errors to callers
+                with self._lock:
+                    self._stats["errors"] += len(batch)
                 for p in batch:
                     p.error = str(e)
                     p.event.set()
@@ -199,6 +327,10 @@ class ServingServer:
         return False
 
     def _score(self, batch: List[_Pending]):
+        # injection point for the overload/robustness tests: a delay
+        # here simulates a slow model (queue backs up -> 503s), a raise
+        # simulates a failing one (500s surface to callers)
+        fault_point("serving.score")
         keep_id = self._keep_id
         ids = []
         for p in batch:
@@ -240,6 +372,9 @@ class ContinuousServingServer(ServingServer):
         super().__init__(model, **kwargs)
         self._score_lock = threading.Lock()
         self._warmup_payload = warmup_payload
+        # synchronous mode has no queue; the backpressure bound caps
+        # how many requests may WAIT on the scorer lock at once
+        self._inflight = threading.BoundedSemaphore(max(self.max_queue, 1))
 
     def start(self) -> "ContinuousServingServer":
         if self._warmup_payload is not None:
@@ -256,13 +391,29 @@ class ContinuousServingServer(ServingServer):
         self._httpd.shutdown()
         self._httpd.server_close()
 
-    def _enqueue(self, pending: "_Pending") -> None:
+    def _enqueue(self, pending: "_Pending") -> bool:
+        if not self._inflight.acquire(blocking=False):
+            with self._lock:
+                self._stats["rejected"] += 1
+                self._last_shed = time.monotonic()
+            warn_once(
+                "serving.backpressure",
+                "serving queue full (max_queue=%s); shedding load "
+                "with 503 + Retry-After", self.max_queue)
+            return False
         try:
             with self._score_lock:
                 self._score([pending])
+            with self._lock:
+                self._stats["served"] += 1
         except Exception as e:
+            with self._lock:
+                self._stats["errors"] += 1
             pending.error = str(e)
             pending.event.set()
+        finally:
+            self._inflight.release()
+        return True
 
 
 class ServingFleet:
@@ -289,11 +440,20 @@ class ServingFleet:
                 pass
 
             def do_GET(self):
-                if self.path != "/registry":
+                if self.path == "/registry":
+                    obj = {"workers": [s.url for s in fleet.servers]}
+                elif self.path == "/healthz":
+                    # fleet-level health: the registry runs in-process
+                    # with its workers, so it can aggregate their
+                    # health snapshots without extra HTTP hops
+                    workers = [s._health() for s in fleet.servers]
+                    status = ("degraded" if any(
+                        w["status"] != "ok" for w in workers) else "ok")
+                    obj = {"status": status, "workers": workers}
+                else:
                     self.send_error(404)
                     return
-                body = json.dumps({
-                    "workers": [s.url for s in fleet.servers]}).encode()
+                body = json.dumps(obj).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))

@@ -119,6 +119,10 @@ class BoosterArrays:
           did.
         - ``"unsupported"``: mixed per-node zero semantics a single
           per-feature bin id cannot express — use ``predict_fn``.
+
+        Memoized under the same immutable-after-construction assumption
+        as ``supports_binned``: derive modified boosters with
+        ``dataclasses.replace``, never by mutating arrays in place.
         """
         cached = self.__dict__.get("_zero_premap_mode")
         if cached is None:

@@ -549,10 +549,19 @@ class _LightGBMBase(Estimator, _LightGBMParams):
             else:
                 # fresh dir, or a pre-fingerprint checkpoint dir:
                 # absence is not evidence of mismatch — backfill
-                tmp = meta_path + ".tmp"
-                with open(tmp, "w") as fh:
-                    json.dump({"fingerprint": fprint}, fh)
-                os.replace(tmp, meta_path)
+                from mmlspark_tpu.core.logging_utils import warn_once
+                from mmlspark_tpu.core.serialize import atomic_write
+                try:
+                    atomic_write(meta_path,
+                                 json.dumps({"fingerprint": fprint}))
+                except OSError as e:
+                    # same degradation contract as the checkpoint
+                    # writes below: a broken store never kills the fit
+                    warn_once(
+                        "gbdt.checkpoint_skip",
+                        "checkpoint fingerprint write failed (%s: %s); "
+                        "continuing WITHOUT checkpoints this run",
+                        type(e).__name__, e)
             if latest is not None:
                 done, path = latest
                 if done > total:
@@ -580,11 +589,23 @@ class _LightGBMBase(Estimator, _LightGBMParams):
                     iteration_offset=done)
                 init_model = result.booster
                 done += seg
-                tmp = os.path.join(ckpt_dir, f".checkpoint_{done}.tmp")
-                with open(tmp, "w") as fh:
-                    fh.write(result.booster.save_model_string())
-                os.replace(tmp, os.path.join(ckpt_dir,
-                                             f"checkpoint_{done}.txt"))
+                from mmlspark_tpu.core.logging_utils import warn_once
+                from mmlspark_tpu.core.serialize import atomic_write
+                try:
+                    atomic_write(
+                        os.path.join(ckpt_dir, f"checkpoint_{done}.txt"),
+                        result.booster.save_model_string())
+                except OSError as e:
+                    # graceful degradation: a failing checkpoint store
+                    # (full disk, flaky blob mount) must not kill a
+                    # healthy fit — training continues, restart depth
+                    # just shrinks; say so once per process
+                    warn_once(
+                        "gbdt.checkpoint_skip",
+                        "checkpoint write at iteration %s failed "
+                        "(%s: %s); continuing WITHOUT this checkpoint "
+                        "— a crash now restarts from the previous one",
+                        done, type(e).__name__, e)
         else:
             result = train(
                 binned, y, cfg, weights=w, group_ids=group_ids,

@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from mmlspark_tpu.core.faults import fault_point
 from mmlspark_tpu.models.gbdt import metrics as metrics_mod
 from mmlspark_tpu.models.gbdt import objectives as obj_mod
 from mmlspark_tpu.models.gbdt.booster import BoosterArrays
@@ -386,6 +387,10 @@ def _native_hist_primitive():
     prim = jcore.Primitive("mmlspark_native_level_hist")
 
     def _run(bn, g, h, lv, lo, width, n_bins):
+        # host-callback boundary: an armed delay here simulates a hung
+        # native kernel (the failure mode the raw-callback redesign
+        # exists to avoid), a corrupt simulates bad kernel output
+        fault_point("native.callback")
         from mmlspark_tpu.native import bindings
         return bindings.level_histogram(bn, g, h, lv, lo, width, n_bins)
 
@@ -445,6 +450,7 @@ def _native_level_histogram(binned, grad, hess, live, local, width, f, b):
     _warn_async_callback_hazard()
 
     def _cb(bn, g, h, lv, lo, _w=width, _b=b):
+        fault_point("native.callback")
         from mmlspark_tpu.native import bindings
         return bindings.level_histogram(np.asarray(bn), np.asarray(g),
                                         np.asarray(h), np.asarray(lv),
@@ -1860,6 +1866,10 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
     def sync_metrics_through(upto):
         """Pull metric rows [len(met_host), upto) to host in one get."""
         if upto > len(met_host):
+            # host boundary of the cross-replica metric reduction: the
+            # device_get below is where an allreduce failure would
+            # surface, so the injection point lives here
+            fault_point("allreduce")
             stacked = jnp.stack([outs[i][4] for i in
                                  range(len(met_host), upto)])
             met_host.extend(np.asarray(jax.device_get(stacked)))
@@ -1892,6 +1902,11 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
 
     it = 0
     while it < total:
+        # per-iteration injection point (host side, outside the jitted
+        # step): arming a raise here is the deterministic stand-in for
+        # a preempted worker mid-fit — the kill-and-resume parity test
+        # interrupts exactly here and resumes from the last checkpoint
+        fault_point("gbdt.train_step")
         with measures.phase("training"):
             carry, ys = step_fn(data, carry, it + iteration_offset)
             outs.append(ys)
@@ -2034,6 +2049,8 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
     labels_host = np.asarray(labels_d) if pos_neg else None
     bag_mask = rv_host.copy()
     for it in range(cfg.num_iterations):
+        # same per-iteration injection point as the fused path
+        fault_point("gbdt.train_step")
         # ----- sampling masks (host RNG, deterministic by seed) ----------
         if (cfg.bagging_freq > 0
                 and (cfg.bagging_fraction < 1.0 or pos_neg)

@@ -326,6 +326,14 @@ class _VWParams(HasLabelCol, HasWeightCol, HasPredictionCol):
                            "so default off for parity)", to_bool,
                            default=False)
     seed = Param("seed", "seed", to_int, default=0)
+    checkpointDir = Param(
+        "checkpointDir", "directory for pass-boundary optimizer-state "
+        "checkpoints (weights + AdaGrad/normalization accumulators + "
+        "schedule counters, the --save_resume state); a restarted fit "
+        "resumes from the latest one", to_str)
+    checkpointInterval = Param(
+        "checkpointInterval", "save a checkpoint every n passes (0 = "
+        "off; requires checkpointDir)", to_int, ge(0), default=0)
     passThroughArgs = Param("passThroughArgs", "VW-style argument string; "
                             "recognized flags are mapped onto params "
                             "(ParamsStringBuilder analog)", to_str, default="")
@@ -519,14 +527,62 @@ class _VWBaseLearner(Estimator, _VWParams):
         from mmlspark_tpu.core.timer import StopWatch
         watch = StopWatch()
         pass_losses: List[float] = []
+        # -- pass-boundary checkpoints + elastic restart ----------------
+        # The VW analog of the GBDT elastic-restart path: the full
+        # resumable state (weights, AdaGrad g2, normalization scales,
+        # bias, schedule counters t/n_acc — exactly what VW
+        # --save_resume persists) snapshots through the shared
+        # serialize.save_checkpoint protocol (atomic write-rename,
+        # monotonic pass tag, config-hash manifest). A resumed fit
+        # continues bit-exactly: the state is the entire carry of the
+        # pass loop. Progressive mode never checkpoints (its product is
+        # the pass-0 prediction stream, not the final weights).
+        ckpt_every = 0 if progressive else get("checkpointInterval")
+        start_pass = 0
+        ckpt_dir = fhash = None
+        if ckpt_every:
+            if not self.is_set("checkpointDir"):
+                raise ValueError(
+                    "checkpointInterval requires checkpointDir")
+            from mmlspark_tpu.core.serialize import (
+                load_latest_checkpoint, save_checkpoint)
+            ckpt_dir = self.get("checkpointDir")
+            fhash = self._checkpoint_fingerprint(
+                sgd_args, sgd_kwargs, get, idx, val, y, wt, init)
+            latest = load_latest_checkpoint(ckpt_dir, fhash)
+            if latest is not None:
+                start_pass, st = latest
+                if start_pass > get("numPasses"):
+                    raise ValueError(
+                        f"checkpoint at pass {start_pass} in {ckpt_dir} "
+                        f"exceeds numPasses={get('numPasses')}; clear "
+                        "the directory or raise numPasses")
+                w = jnp.asarray(st["weights"], jnp.float32)
+                g2 = jnp.asarray(st["g2"], jnp.float32)
+                s = jnp.asarray(st["scale"], jnp.float32)
+                bias = jnp.asarray(np.float32(st["bias"]))
+                n_acc = jnp.asarray(np.float32(st["n_acc"]))
+                t = jnp.asarray(np.float32(st["t_count"]))
+                pass_losses = [float(x) for x in st.get("passLosses", [])]
         with watch.measure():
             for p in range(get("numPasses")):
                 if p > 0 and self.get("shufflePerPass"):
+                    # replayed even for checkpointed-and-skipped passes
+                    # so the shuffle RNG stream (and therefore the data
+                    # order of every later pass) matches the
+                    # uninterrupted run exactly
                     order = rng_order.permutation(nb_total)
                     bidx, bval = bidx[order], bval[order]
                     by, bwt = by[order], bwt[order]
+                if p < start_pass:
+                    continue  # completed before the restart
                 preds_parts = []
                 for b0 in range(0, nb_total, seg):
+                    if mesh is not None and self.get("interPassSync"):
+                        # host boundary of the cross-shard weight
+                        # average (the VW spanning-tree allreduce)
+                        from mmlspark_tpu.core.faults import fault_point
+                        fault_point("allreduce")
                     w, g2, s, n_acc, bias, t, preds = run_pass(
                         w, g2, s, n_acc, bias, t,
                         jnp.asarray(bidx[b0:b0 + seg]),
@@ -539,6 +595,28 @@ class _VWBaseLearner(Estimator, _VWParams):
                     all_preds = np.concatenate(preds_parts)[:len(y)]
                 pass_losses.append(self._train_loss(
                     np.asarray(w), float(bias), idx, val, y, wt))
+                if ckpt_every and ((p + 1) % ckpt_every == 0
+                                   or p + 1 == get("numPasses")):
+                    try:
+                        save_checkpoint(
+                            ckpt_dir, p + 1,
+                            {"weights": np.asarray(w),
+                             "g2": np.asarray(g2),
+                             "scale": np.asarray(s),
+                             "bias": float(bias),
+                             "n_acc": float(n_acc),
+                             "t_count": float(t),
+                             "passLosses": [float(x)
+                                            for x in pass_losses]},
+                            fhash)
+                    except OSError as e:
+                        from mmlspark_tpu.core.logging_utils import \
+                            warn_once
+                        warn_once(
+                            "vw.checkpoint_skip",
+                            "VW checkpoint write at pass %s failed "
+                            "(%s: %s); continuing WITHOUT this "
+                            "checkpoint", p + 1, type(e).__name__, e)
         state = {
             "weights": np.asarray(w),
             "g2": np.asarray(g2),
@@ -572,6 +650,33 @@ class _VWBaseLearner(Estimator, _VWParams):
         else:
             per = (margin - y) ** 2
         return float((per * wt).sum() / max(wt.sum(), 1e-12))
+
+    @staticmethod
+    def _checkpoint_fingerprint(sgd_args, sgd_kwargs, get, idx, val, y,
+                                wt, init=None) -> str:
+        """Digest of everything a resumed pass must agree on: the SGD
+        config (numPasses deliberately excluded — raising the pass
+        budget is the supported elastic-restart path), the batch/shuffle
+        schedule, and a cheap data digest (shapes + corner slices +
+        moments, mirroring the GBDT fingerprint)."""
+        import hashlib
+
+        cfg = {k: v for k, v in sorted(sgd_kwargs.items())
+               if k != "progressive"}
+        h = hashlib.sha256(repr((sgd_args, cfg, get("batchSize"),
+                                 get("seed"), get("syncScheduleRows"),
+                                 get("shufflePerPass")),).encode())
+        h.update(repr((idx.shape, bool(init is not None))).encode())
+        for a in (idx, val, y, wt):
+            h.update(np.ascontiguousarray(a[:64]).tobytes())
+            h.update(np.ascontiguousarray(a[-64:]).tobytes())
+        h.update(np.asarray([float(np.sum(val)), float(np.sum(y)),
+                             float(np.sum(wt))]).tobytes())
+        if init is not None and init.weights is not None:
+            h.update(np.asarray(
+                [float(np.sum(init.weights)),
+                 float(init.bias)]).tobytes())
+        return h.hexdigest()[:16]
 
     def set_initial_model(self, model: "_VWBaseModel") -> "_VWBaseLearner":
         """Warm start from a fitted model (VW ``initialModel`` / the

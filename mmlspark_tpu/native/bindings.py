@@ -10,6 +10,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from mmlspark_tpu.core.faults import fault_point
 from mmlspark_tpu.core.logging_utils import logger
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
@@ -175,10 +176,13 @@ def level_histogram(binned: np.ndarray, grad: np.ndarray,
            local.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
            width, n_bins,
            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
-        return out
+        # injection point on the histogram RESULT: arming corrupt here
+        # proves a bad data-plane answer changes the model (so parity
+        # tests really exercise this kernel); delay simulates a slow one
+        return fault_point("gbdt.level_hist", out)
     out = np.zeros((width, f, n_bins, 3), np.float32)
     if n == 0:
-        return out
+        return fault_point("gbdt.level_hist", out)
     idx_base = local.astype(np.int64) * n_bins
     chans = (grad * live, hess * live, live)
     for j in range(f):
@@ -187,7 +191,7 @@ def level_histogram(binned: np.ndarray, grad: np.ndarray,
             out[:, j, :, c] = np.bincount(
                 idx, weights=w, minlength=width * n_bins
             ).reshape(width, n_bins).astype(np.float32)
-    return out
+    return fault_point("gbdt.level_hist", out)
 
 
 def load_csv(path: str, skip_header: bool = True

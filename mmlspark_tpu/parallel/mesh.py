@@ -144,6 +144,8 @@ def distributed_init(coordinator_address: Optional[str] = None,
     """
     import jax
 
+    from mmlspark_tpu.core.faults import fault_point
+
     if cpu_devices_per_process is not None:
         from mmlspark_tpu.core.virtual_devices import force_cpu_devices
         force_cpu_devices(cpu_devices_per_process)
@@ -180,16 +182,18 @@ def distributed_init(coordinator_address: Optional[str] = None,
                     "any JAX computations are executed.")
             interval = max(1, int(hb) // 5)
             missing = max(2, -(-int(hb) // interval))
-            _distributed.global_state.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-                local_device_ids=local_device_ids,
-                service_heartbeat_interval_seconds=interval,
-                service_max_missing_heartbeats=missing,
-                client_heartbeat_interval_seconds=interval,
-                client_max_missing_heartbeats=missing,
-                **{k: v for k, v in kwargs.items() if k in inner})
+            _init_with_retries(
+                lambda: _distributed.global_state.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                    local_device_ids=local_device_ids,
+                    service_heartbeat_interval_seconds=interval,
+                    service_max_missing_heartbeats=missing,
+                    client_heartbeat_interval_seconds=interval,
+                    client_max_missing_heartbeats=missing,
+                    **{k: v for k, v in kwargs.items() if k in inner}),
+                fault_point)
             return
         except ImportError:
             import warnings
@@ -204,12 +208,44 @@ def distributed_init(coordinator_address: Optional[str] = None,
             f"jax.distributed.initialize on jax {jax.__version__} does "
             f"not accept {dropped}; dropping", stacklevel=2)
         kwargs = {k: v for k, v in kwargs.items() if k in accepted}
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids,
-        **kwargs)
+    _init_with_retries(
+        lambda: jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+            **kwargs),
+        fault_point)
+
+
+def _init_with_retries(init_fn, fault_point) -> None:
+    """Rendezvous with bounded retries: a coordinator that is still
+    coming up (a restarted process 0, a slow container) must not kill
+    every joiner permanently — the reference's executors likewise retry
+    into the driver's ServerSocket. Attempts come from
+    ``MMLSPARK_TPU_DIST_INIT_RETRIES`` (total tries, default 3);
+    mis-use errors (double init, bad arguments) never retry."""
+    import os
+
+    from mmlspark_tpu.core.retries import RetryPolicy, with_retries
+
+    def attempt():
+        fault_point("distributed.init")
+        init_fn()
+
+    def should_retry(e: BaseException) -> bool:
+        if isinstance(e, (ValueError, TypeError)):
+            return False
+        msg = str(e).lower()
+        # "should only be called once" / "must be called before any
+        # JAX computations": programming errors, not transient
+        return "once" not in msg and "before any" not in msg
+
+    tries = int(os.environ.get("MMLSPARK_TPU_DIST_INIT_RETRIES", "3"))
+    with_retries(attempt,
+                 policy=RetryPolicy(max_attempts=max(tries, 1),
+                                    base_delay=1.0, max_delay=10.0),
+                 should_retry=should_retry, describe="distributed.init")
 
 
 def process_index() -> int:

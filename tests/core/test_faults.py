@@ -1,0 +1,200 @@
+"""Fault-injection harness + shared retry policy unit tests."""
+
+import time
+
+import pytest
+
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.core.faults import FaultInjected, fault_point, injected
+from mmlspark_tpu.core.logging_utils import (SINK, reset_warn_once,
+                                             warn_once)
+from mmlspark_tpu.core.retries import (RetryPolicy, backoff_schedule,
+                                       with_retries)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class TestFaultPoint:
+    def test_disabled_is_passthrough(self):
+        assert fault_point("serving.score") is None
+        assert fault_point("serving.score", 42) == 42
+        # the fast path does not even count hits
+        assert faults.hits("serving.score") == 0
+
+    def test_unknown_point_refused(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            faults.arm("no.such.point")
+        with pytest.raises(ValueError, match="action must be one of"):
+            faults.arm("io.http", "explode")
+
+    def test_raise_on_nth_hit_once(self):
+        faults.arm("io.http", "raise", nth=3, count=1)
+        fault_point("io.http")
+        fault_point("io.http")
+        with pytest.raises(FaultInjected):
+            fault_point("io.http")
+        # count=1: the fault fired, later hits pass through
+        fault_point("io.http")
+        assert faults.hits("io.http") == 4
+
+    def test_raise_custom_exception(self):
+        faults.arm("checkpoint.write", "raise", exc=OSError("disk full"))
+        with pytest.raises(OSError, match="disk full"):
+            fault_point("checkpoint.write")
+
+    def test_unbounded_count(self):
+        faults.arm("io.http", "raise", nth=1, count=None)
+        for _ in range(3):
+            with pytest.raises(FaultInjected):
+                fault_point("io.http")
+
+    def test_delay(self):
+        faults.arm("serving.score", "delay", delay_s=0.05)
+        t0 = time.perf_counter()
+        fault_point("serving.score")
+        assert time.perf_counter() - t0 >= 0.05
+
+    def test_corrupt_transforms_value(self):
+        faults.arm("gbdt.level_hist", "corrupt",
+                   corrupt=lambda v: v * 0, count=None)
+        assert fault_point("gbdt.level_hist", 7) == 0
+        faults.disarm("gbdt.level_hist")
+        assert fault_point("gbdt.level_hist", 7) == 7
+
+    def test_injected_context_disarms_on_error(self):
+        with pytest.raises(FaultInjected):
+            with injected("io.http", "raise"):
+                fault_point("io.http")
+        assert fault_point("io.http", "fine") == "fine"
+
+    def test_arm_from_env(self):
+        faults.arm_from_env("io.http:raise:2,serving.score:delay:1:0.01")
+        fault_point("io.http")  # hit 1 < nth
+        with pytest.raises(FaultInjected):
+            fault_point("io.http")
+        fault_point("serving.score")  # delays 0.01s, no raise
+
+    def test_arm_from_env_rejects_garbage(self):
+        with pytest.raises(ValueError, match="MMLSPARK_TPU_FAULTS"):
+            faults.arm_from_env("just-a-name")
+
+    def test_registry_reexported_for_fuzzing(self):
+        from tests.fuzzing.registry import fault_point_registry
+        reg = fault_point_registry()
+        assert reg == faults.KNOWN_POINTS
+        assert "serving.score" in reg
+
+
+class TestWithRetries:
+    def test_succeeds_after_transient_failures(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("blip")
+            return "ok"
+
+        out = with_retries(flaky, policy=RetryPolicy(
+            max_attempts=4, base_delay=0.0), sleep=lambda s: None)
+        assert out == "ok" and calls["n"] == 3
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = {"n": 0}
+
+        def fails():
+            calls["n"] += 1
+            raise ValueError("bad arg")
+
+        with pytest.raises(ValueError):
+            with_retries(fails, should_retry=lambda e: not isinstance(
+                e, ValueError), sleep=lambda s: None)
+        assert calls["n"] == 1
+
+    def test_exhaustion_raises_last_and_warns_once(self):
+        reset_warn_once()
+        SINK.drain()
+
+        def always():
+            raise ConnectionError("down")
+
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                with_retries(always, policy=RetryPolicy(
+                    max_attempts=2, base_delay=0.0),
+                    describe="test.exhaust", sleep=lambda s: None)
+        degradations = [e for e in SINK.drain()
+                        if e.get("event") == "degradation"
+                        and "test.exhaust" in e.get("key", "")]
+        assert len(degradations) == 1  # once per process, not per call
+
+    def test_backoff_schedule_uses_fixed_delays(self):
+        slept = []
+
+        def always():
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            with_retries(always, policy=backoff_schedule([0.1, 0.7]),
+                         describe="test.sched", sleep=slept.append)
+        assert slept == [0.1, 0.7]
+
+    def test_min_delay_override_floors(self):
+        slept = []
+
+        def always():
+            raise ConnectionError("429ish")
+
+        with pytest.raises(ConnectionError):
+            with_retries(always, policy=backoff_schedule([0.01]),
+                         min_delay_override=lambda e: 0.5,
+                         describe="test.floor", sleep=slept.append)
+        assert slept == [0.5]
+
+    def test_deadline_caps_total_wait(self):
+        slept = []
+
+        def always():
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            with_retries(
+                always,
+                policy=RetryPolicy(max_attempts=10, base_delay=100.0,
+                                   jitter=0.0, deadline=0.0),
+                describe="test.deadline", sleep=slept.append)
+        assert slept == []  # deadline already spent -> no retries
+
+    def test_exponential_backoff_growth(self):
+        slept = []
+
+        def always():
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            with_retries(
+                always,
+                policy=RetryPolicy(max_attempts=4, base_delay=0.1,
+                                   multiplier=2.0, jitter=0.0,
+                                   max_delay=10.0),
+                describe="test.growth", sleep=slept.append)
+        assert slept == pytest.approx([0.1, 0.2, 0.4])
+
+
+class TestWarnOnce:
+    def test_emits_once_and_records_telemetry(self):
+        reset_warn_once()
+        SINK.drain()
+        assert warn_once("test.key.abc", "degraded %s", "now")
+        assert not warn_once("test.key.abc", "degraded %s", "again")
+        events = [e for e in SINK.drain()
+                  if e.get("key") == "test.key.abc"]
+        assert len(events) == 1
+        assert events[0]["event"] == "degradation"

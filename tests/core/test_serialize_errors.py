@@ -1,0 +1,140 @@
+"""Error-path coverage for stage persistence and the crash-safe
+checkpoint protocol (extends the fuzzing round-trip suite, which only
+exercises the happy path): truncated manifests, missing array payloads,
+and config-hash mismatches on resume must fail loudly or fall back
+safely — never load garbage."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import serialize
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.logging_utils import reset_warn_once
+from mmlspark_tpu.core.serialize import (load_latest_checkpoint,
+                                         load_stage, save_checkpoint,
+                                         save_stage)
+
+
+@pytest.fixture()
+def vw_model(rng):
+    from mmlspark_tpu.models.vw.learners import VowpalWabbitRegressor
+    x = rng.normal(size=(40, 3))
+    y = x[:, 0] - 0.5 * x[:, 1]
+    df = DataFrame({"features": x, "label": y})
+    return VowpalWabbitRegressor(numPasses=1).fit(df)
+
+
+class TestStageErrorPaths:
+    def test_roundtrip_baseline(self, vw_model, tmp_path):
+        path = str(tmp_path / "stage")
+        save_stage(vw_model, path)
+        loaded = load_stage(path)
+        np.testing.assert_array_equal(loaded.weights, vw_model.weights)
+
+    def test_truncated_metadata_raises(self, vw_model, tmp_path):
+        path = str(tmp_path / "stage")
+        save_stage(vw_model, path)
+        meta = os.path.join(path, "metadata.json")
+        with open(meta) as fh:
+            text = fh.read()
+        with open(meta, "w") as fh:
+            fh.write(text[: len(text) // 2])  # torn mid-write
+        with pytest.raises(json.JSONDecodeError):
+            load_stage(path)
+
+    def test_missing_arrays_file_raises(self, vw_model, tmp_path):
+        path = str(tmp_path / "stage")
+        save_stage(vw_model, path)
+        os.remove(os.path.join(path, "arrays.npz"))
+        with pytest.raises((KeyError, FileNotFoundError)):
+            load_stage(path)
+
+    def test_missing_metadata_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_stage(str(tmp_path / "nope"))
+
+
+class TestCheckpointProtocol:
+    STATE = {"weights": np.arange(6, dtype=np.float32), "bias": 0.5,
+             "passLosses": [1.0, 0.5]}
+
+    def test_roundtrip_picks_latest_tag(self, tmp_path):
+        d = str(tmp_path)
+        save_checkpoint(d, 1, {"weights": np.zeros(3), "bias": 0.0}, "h1")
+        save_checkpoint(d, 2, self.STATE, "h1")
+        tag, state = load_latest_checkpoint(d, "h1")
+        assert tag == 2
+        np.testing.assert_array_equal(state["weights"],
+                                      self.STATE["weights"])
+        assert state["bias"] == 0.5
+        assert state["passLosses"] == [1.0, 0.5]
+
+    def test_empty_or_missing_dir(self, tmp_path):
+        assert load_latest_checkpoint(str(tmp_path / "none")) is None
+        assert load_latest_checkpoint(str(tmp_path)) is None
+
+    def test_wrong_config_hash_refused(self, tmp_path):
+        d = str(tmp_path)
+        save_checkpoint(d, 1, self.STATE, "h1")
+        with pytest.raises(ValueError,
+                           match="different config or dataset"):
+            load_latest_checkpoint(d, "OTHER")
+
+    def test_truncated_manifest_falls_back(self, tmp_path):
+        reset_warn_once()
+        d = str(tmp_path)
+        save_checkpoint(d, 1, self.STATE, "h1")
+        save_checkpoint(d, 2, {"weights": np.ones(2), "bias": 9.0}, "h1")
+        manifest = os.path.join(d, "ckpt_00000002.json")
+        with open(manifest) as fh:
+            text = fh.read()
+        with open(manifest, "w") as fh:
+            fh.write(text[: len(text) // 3])
+        tag, state = load_latest_checkpoint(d, "h1")
+        assert tag == 1  # torn tag 2 skipped, earlier one recovered
+
+    def test_missing_payload_falls_back(self, tmp_path):
+        reset_warn_once()
+        d = str(tmp_path)
+        save_checkpoint(d, 1, self.STATE, "h1")
+        save_checkpoint(d, 2, {"weights": np.ones(2), "bias": 9.0}, "h1")
+        os.remove(os.path.join(d, "ckpt_00000002.npz"))
+        tag, state = load_latest_checkpoint(d, "h1")
+        assert tag == 1
+
+    def test_tmp_debris_is_invisible(self, tmp_path):
+        d = str(tmp_path)
+        save_checkpoint(d, 1, self.STATE, "h1")
+        # a writer SIGKILLed before the manifest commit point
+        with open(os.path.join(d, "ckpt_00000002.npz"), "wb") as fh:
+            fh.write(b"half an npz")
+        with open(os.path.join(d, "ckpt_00000002.json.tmp"), "w") as fh:
+            fh.write('{"tag": 2')
+        tag, _ = load_latest_checkpoint(d, "h1")
+        assert tag == 1
+
+    def test_atomic_write_never_tears(self, tmp_path):
+        p = str(tmp_path / "f.txt")
+        serialize.atomic_write(p, "hello")
+        serialize.atomic_write(p, "world")
+        with open(p) as fh:
+            assert fh.read() == "world"
+        assert not os.path.exists(p + ".tmp")
+
+    def test_checkpoint_write_fault_degrades(self, tmp_path):
+        """An armed checkpoint.write OSError surfaces to the caller —
+        the training loops catch it and continue (checkpoint skip)."""
+        from mmlspark_tpu.core import faults
+        faults.reset()
+        try:
+            with faults.injected("checkpoint.write", "raise",
+                                 exc=OSError("disk full")):
+                with pytest.raises(OSError, match="disk full"):
+                    save_checkpoint(str(tmp_path), 1, self.STATE, "h1")
+        finally:
+            faults.reset()
+        # nothing half-written got committed
+        assert load_latest_checkpoint(str(tmp_path), "h1") is None

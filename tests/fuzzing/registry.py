@@ -502,6 +502,20 @@ def _tiny_onnx_model() -> bytes:
     return m.SerializeToString()
 
 
+def fault_point_registry() -> Dict[str, str]:
+    """Named fault-injection points the fuzzing/chaos suites can arm
+    (the robustness analog of TestObject registration): the canonical
+    list lives in :mod:`mmlspark_tpu.core.faults` (``KNOWN_POINTS``);
+    this re-export keeps fuzzing drivers decoupled from core imports.
+    Arm via ``mmlspark_tpu.core.faults.injected(name, action, ...)`` or
+    ``MMLSPARK_TPU_FAULTS="name:action[:nth[:param]]"``. The
+    completeness test (tests/gbdt/test_fault_injection.py) pins that
+    every production ``fault_point("...")`` call site names a
+    registered point."""
+    from mmlspark_tpu.core.faults import KNOWN_POINTS
+    return dict(KNOWN_POINTS)
+
+
 # Stages with no TestObject, with the reason (FuzzingTest exemption-list
 # parity, FuzzingTest.scala:19-80)
 EXEMPT: Dict[str, str] = {

@@ -1,0 +1,240 @@
+"""Serving under overload: bounded queues, 503 + Retry-After load
+shedding, /healthz degradation, connection caps and per-request
+deadlines — driven by armed faults instead of real slow models, so the
+overload is deterministic and CI-fast."""
+
+import http.client
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.io.serving import (ContinuousServingServer,
+                                     ServingFleet, ServingServer)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class _DoubleModel(Transformer):
+    def _transform(self, df):
+        return df.with_column("doubled", np.asarray(df.col("x")) * 2.0)
+
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def _get_json(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_healthz_baseline_ok():
+    with ServingServer(_DoubleModel(), max_latency_ms=2) as server:
+        _post(server.url, {"x": 1.0})
+        health = _get_json(f"http://{server.host}:{server.port}/healthz")
+    assert health["status"] == "ok"
+    assert health["served"] >= 1
+    assert health["queueDepth"] == 0
+    assert health["maxQueue"] == 256
+
+
+def test_slow_score_sheds_load_with_retry_after_and_degraded_health():
+    """Acceptance: under injected slow-score load the server answers
+    503 + Retry-After instead of queueing unboundedly, and /healthz
+    reflects the degraded state."""
+    faults.arm("serving.score", "delay", delay_s=0.25, count=None)
+    with ServingServer(_DoubleModel(), max_queue=4, max_batch_size=1,
+                       max_latency_ms=1, request_timeout_s=10,
+                       retry_after_s=2) as server:
+        codes, retry_afters = [], []
+        lock = threading.Lock()
+
+        def call(i):
+            try:
+                status, out, _ = _post(server.url, {"x": float(i)})
+                with lock:
+                    codes.append(status)
+            except urllib.error.HTTPError as e:
+                with lock:
+                    codes.append(e.code)
+                    retry_afters.append(e.headers.get("Retry-After"))
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)  # mid-overload: queue full, scorer sleeping
+        health = _get_json(f"http://{server.host}:{server.port}/healthz")
+        for t in threads:
+            t.join()
+    shed = [c for c in codes if c == 503]
+    ok = [c for c in codes if c == 200]
+    assert shed, f"no load was shed: {codes}"
+    assert ok, f"nothing succeeded: {codes}"
+    assert all(ra == "2" for ra in retry_afters)
+    assert health["status"] == "degraded"
+    assert health["rejected"] >= 1
+
+
+def test_request_deadline_times_out_504():
+    faults.arm("serving.score", "delay", delay_s=0.5, count=None)
+    with ServingServer(_DoubleModel(), max_batch_size=1,
+                       max_latency_ms=1,
+                       request_timeout_s=0.1) as server:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(server.url, {"x": 1.0})
+        assert e.value.code == 504
+
+
+def test_connection_cap_rejects_with_503():
+    """Beyond max_connections, new connections get an immediate 503 +
+    Retry-After and are closed — idle keep-alive clients can no longer
+    grow server threads without bound."""
+    with ServingServer(_DoubleModel(), max_connections=2,
+                       max_latency_ms=2) as server:
+        held = []
+        try:
+            for _ in range(2):  # two persistent keep-alive connections
+                c = http.client.HTTPConnection(server.host, server.port,
+                                               timeout=5)
+                c.request("GET", "/healthz")
+                r = c.getresponse()
+                assert r.status == 200
+                r.read()
+                held.append(c)  # keep open: each pins one thread
+            c3 = http.client.HTTPConnection(server.host, server.port,
+                                            timeout=5)
+            c3.request("GET", "/healthz")
+            r3 = c3.getresponse()
+            assert r3.status == 503
+            assert r3.headers.get("Retry-After") is not None
+            c3.close()
+        finally:
+            for c in held:
+                c.close()
+
+
+def test_idle_keepalive_timeout_closes_connection():
+    """The keep-alive idle timeout is capped: a client that goes idle
+    has its connection (and thread) reclaimed."""
+    with ServingServer(_DoubleModel(), idle_timeout_s=0.3,
+                       max_latency_ms=2) as server:
+        s = socket.create_connection((server.host, server.port),
+                                     timeout=5)
+        try:
+            s.sendall(b"GET /healthz HTTP/1.1\r\nHost: x\r\n\r\n")
+            s.settimeout(5)
+            # drain the whole response (headers + body may arrive in
+            # separate segments) up to the closing brace of the JSON
+            buf = b""
+            while b"}" not in buf:
+                chunk = s.recv(4096)
+                assert chunk, "connection died before the response"
+                buf += chunk
+            assert b"200" in buf.split(b"\r\n", 1)[0]
+            time.sleep(0.8)  # idle past the cap
+            s.settimeout(2)
+            leftover = s.recv(4096)
+            assert leftover == b"", "idle connection was not closed"
+        finally:
+            s.close()
+
+
+def test_continuous_server_bounds_inflight():
+    faults.arm("serving.score", "delay", delay_s=0.3, count=None)
+    server = ContinuousServingServer(_DoubleModel(), max_queue=1).start()
+    try:
+        codes = []
+        lock = threading.Lock()
+
+        def call(i):
+            try:
+                status, _, _ = _post(server.url, {"x": float(i)})
+                with lock:
+                    codes.append(status)
+            except urllib.error.HTTPError as e:
+                with lock:
+                    codes.append(e.code)
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert 503 in codes and 200 in codes, codes
+    finally:
+        server.stop()
+
+
+def test_fleet_registry_aggregates_health():
+    with ServingFleet(_DoubleModel(), num_servers=2,
+                      max_latency_ms=2) as fleet:
+        url = (f"http://{fleet.registry_host}:{fleet.registry_port}"
+               "/healthz")
+        health = _get_json(url)
+        assert health["status"] == "ok"
+        assert len(health["workers"]) == 2
+        # per-worker /healthz is also live
+        w = fleet.servers[0]
+        assert _get_json(
+            f"http://{w.host}:{w.port}/healthz")["status"] == "ok"
+
+
+def test_http_transformer_retries_injected_fault(rng):
+    """An armed io.http raise on the first attempt is transparently
+    retried by the shared with_retries policy."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from mmlspark_tpu.io.http import HTTPTransformer
+
+    class _Echo(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            body = b'{"ok": true}'
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Echo)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        host, port = httpd.server_address
+        reqs = np.empty(1, dtype=object)
+        reqs[0] = {"url": f"http://{host}:{port}/x", "method": "POST",
+                   "body": "{}"}
+        faults.arm("io.http", "raise", nth=1, count=1)
+        out = HTTPTransformer(inputCol="r", outputCol="resp",
+                              backoffs=[0.01, 0.01]).transform(
+            DataFrame({"r": reqs}))
+        assert out.col("resp")[0].status_code == 200
+        assert faults.hits("io.http") == 2  # failed attempt + retry
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
