@@ -40,8 +40,9 @@ def enable_persistent_cache(path: str = None) -> str:
     missing). Returns the directory used. Safe to call more than once."""
     import jax
 
-    cache_dir = path or os.environ.get("MMLSPARK_TPU_COMPILE_CACHE",
-                                       DEFAULT_DIR)
+    from mmlspark_tpu.core.env import env_str
+    cache_dir = path or env_str("MMLSPARK_TPU_COMPILE_CACHE",
+                                DEFAULT_DIR)
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

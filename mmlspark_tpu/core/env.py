@@ -1,0 +1,192 @@
+"""Centralized, typed environment-variable access.
+
+Every ``MMLSPARK_TPU_*`` knob the framework reads is declared ONCE in
+the :data:`REGISTRY` below and read through the typed helpers
+(:func:`env_flag` / :func:`env_int` / :func:`env_str` / :func:`env_raw`).
+This is the single source of truth that the graftlint GL004 checker
+(tools/graftlint) reconciles against PARAMS.md and README.md, so a knob
+cannot ship undocumented and a doc row cannot outlive its code.
+
+Raw ``os.environ`` access to ``MMLSPARK_TPU_*`` names anywhere else in
+the package is a lint error (GL004); non-framework variables (JAX_*,
+XLA_*, platform detection) are out of scope and stay where they are.
+
+Parsing contract (shared with the pre-existing knobs, see
+``resolve_histogram_formulation``'s bad-value handling): a malformed
+value must not abort — or silently mislabel — a run, so ``env_flag`` /
+``env_int`` warn once per variable and fall back to the default instead
+of raising.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Dict, Iterator, Optional, Set
+
+_TRUTHY = frozenset(("1", "true", "yes", "on"))
+_FALSEY = frozenset(("0", "false", "off", "no"))
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    """One declared knob: parse kind, default, one-line effect."""
+
+    name: str
+    kind: str            # "flag" | "int" | "str"
+    default: object
+    description: str
+
+
+REGISTRY: Dict[str, EnvVar] = {}
+
+
+def register(name: str, kind: str, default: object,
+             description: str) -> str:
+    """Declare a knob; returns ``name`` so declarations double as
+    importable constants. GL004 parses these literal registrations as
+    the code-side env-var inventory."""
+    REGISTRY[name] = EnvVar(name, kind, default, description)
+    return name
+
+
+# --- the one registry (keep PARAMS.md "Engine knobs" tables in sync;
+# --- GL004 fails the build when they drift) ---------------------------
+HIST_FORMULATION = register(
+    "MMLSPARK_TPU_HIST_FORMULATION", "str", "",
+    "force a histogram formulation: per_feature|separate|fused|onehot|"
+    "native; impossible combinations downgrade with a warning")
+NATIVE_HIST = register(
+    "MMLSPARK_TPU_NATIVE_HIST", "flag", True,
+    "=0 disables the native C++ CPU histogram default (back to XLA)")
+HIST_SUB = register(
+    "MMLSPARK_TPU_HIST_SUB", "str", "",
+    "1/0 force the histogram-subtraction trick on/off; unset = native-"
+    "kernel-only default")
+PALLAS_HIST = register(
+    "MMLSPARK_TPU_PALLAS_HIST", "flag", False,
+    "=1 opts into the Pallas TPU histogram kernel")
+PALLAS_FORCE_COMPILE = register(
+    "MMLSPARK_TPU_PALLAS_FORCE_COMPILE", "flag", False,
+    "=1 compiles Pallas kernels through Mosaic even off-TPU (AOT "
+    "lowering tests / TPU-day debugging) instead of interpret mode")
+SYNC_CPU_DISPATCH = register(
+    "MMLSPARK_TPU_SYNC_CPU_DISPATCH", "flag", True,
+    "=0 keeps XLA:CPU asynchronous dispatch (unsafe with pure_callback "
+    "histograms over >~1 MB operands)")
+ONEHOT_CHUNK = register(
+    "MMLSPARK_TPU_ONEHOT_CHUNK", "int", 4096,
+    "rows per MXU dot in the onehot formulation")
+ONEHOT_BF16 = register(
+    "MMLSPARK_TPU_ONEHOT_BF16", "flag", False,
+    "=1 runs onehot-formulation operands in bf16")
+FLASH = register(
+    "MMLSPARK_TPU_FLASH", "flag", False,
+    "=1 opts into the Pallas flash-attention kernel on TPU")
+COMPILE_CACHE = register(
+    "MMLSPARK_TPU_COMPILE_CACHE", "str", None,
+    "persistent XLA compilation-cache directory (default: a per-machine "
+    "dir under ~/.cache)")
+DIST_INIT_RETRIES = register(
+    "MMLSPARK_TPU_DIST_INIT_RETRIES", "int", 3,
+    "total rendezvous attempts in distributed_init")
+FAULTS = register(
+    "MMLSPARK_TPU_FAULTS", "str", "",
+    "arm fault-injection points: comma-separated "
+    "point:action[:nth[:param]]")
+FABRIC_ENDPOINT = register(
+    "MMLSPARK_TPU_FABRIC_ENDPOINT", "str", None,
+    "telemetry endpoint URL for certified events (unset: events stay "
+    "in the in-process sink)")
+FABRIC_TOKEN = register(
+    "MMLSPARK_TPU_FABRIC_TOKEN", "str", None,
+    "bearer token for the telemetry endpoint")
+
+
+_WARNED: Set[str] = set()
+
+
+def _warn_once(name: str, message: str) -> None:
+    if name in _WARNED:
+        return
+    _WARNED.add(name)
+    warnings.warn(message, stacklevel=3)
+
+
+def reset_warnings() -> None:
+    """Forget which variables already warned (test hook)."""
+    _WARNED.clear()
+
+
+def env_raw(name: str) -> Optional[str]:
+    """The unparsed value, ``None`` when unset. For cache keys that must
+    distinguish unset from every set value."""
+    return os.environ.get(name)
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Boolean knob: 1/true/yes/on -> True, 0/false/off/no -> False
+    (case-insensitive); unset/empty -> ``default``; anything else warns
+    once and returns ``default``."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    v = v.strip().lower()
+    if not v:
+        return default
+    if v in _TRUTHY:
+        return True
+    if v in _FALSEY:
+        return False
+    _warn_once(name, f"{name}={v!r} is not a recognized boolean "
+                     f"(1/true/yes/on or 0/false/off/no); using "
+                     f"{default}")
+    return default
+
+
+def env_int(name: str, default: int, minimum: Optional[int] = None) -> int:
+    """Integer knob; a non-integer or below-``minimum`` value warns once
+    and returns ``default`` (a bad value must not abort — or silently
+    mislabel — a measurement run)."""
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return default
+    try:
+        value = int(v.strip())
+    except ValueError:
+        _warn_once(name, f"{name}={v!r} is not an integer; using "
+                         f"{default}")
+        return default
+    if minimum is not None and value < minimum:
+        _warn_once(name, f"{name}={value} is below the minimum "
+                         f"{minimum}; using {default}")
+        return default
+    return value
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """String knob, unstripped (callers strip/validate as needed)."""
+    v = os.environ.get(name)
+    return default if v is None else v
+
+
+@contextmanager
+def env_override(name: str, value: Optional[str]) -> Iterator[None]:
+    """Temporarily set (or, with ``None``, unset) a variable, restoring
+    the previous state on exit — the sanctioned way to scope an env
+    knob around a block (e.g. AOT lowering forcing the non-callback
+    histogram)."""
+    prev = os.environ.get(name)
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = value
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prev

@@ -49,7 +49,8 @@ class TokenLibrary:
     def get_access_token(self) -> Optional[str]:
         if self._provider is not None:
             return self._provider()
-        return os.environ.get(self.ENV_VAR)
+        from mmlspark_tpu.core.env import env_str
+        return env_str(self.ENV_VAR)
 
 
 class FabricClient:
@@ -64,7 +65,8 @@ class FabricClient:
     def __init__(self, endpoint: Optional[str] = None,
                  tokens: Optional[TokenLibrary] = None,
                  timeout: float = 5.0):
-        self.endpoint = endpoint or os.environ.get(
+        from mmlspark_tpu.core.env import env_str
+        self.endpoint = endpoint or env_str(
             "MMLSPARK_TPU_FABRIC_ENDPOINT")
         self.tokens = tokens or TokenLibrary()
         self.timeout = timeout

@@ -34,7 +34,6 @@ reproducible experiment, not a flake.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from contextlib import contextmanager
@@ -195,7 +194,8 @@ def arm_from_env(env: Optional[str] = None) -> None:
     """Parse ``MMLSPARK_TPU_FAULTS`` (or ``env``) and arm the specs in
     it. Malformed entries raise immediately — a chaos run with a typo'd
     spec silently doing nothing would report false health."""
-    raw = env if env is not None else os.environ.get(
+    from mmlspark_tpu.core.env import env_str
+    raw = env if env is not None else env_str(
         "MMLSPARK_TPU_FAULTS", "")
     for entry in filter(None, (e.strip() for e in raw.split(","))):
         parts = entry.split(":")

@@ -44,11 +44,9 @@ def ensure_sync_cpu_dispatch() -> bool:
     global _SYNC_CPU_DISPATCH
     if _SYNC_CPU_DISPATCH is not None:
         return _SYNC_CPU_DISPATCH
-    import os
+    from mmlspark_tpu.core.env import env_flag
 
-    v = os.environ.get("MMLSPARK_TPU_SYNC_CPU_DISPATCH",
-                       "").strip().lower()
-    if v in ("0", "false", "off", "no"):
+    if not env_flag("MMLSPARK_TPU_SYNC_CPU_DISPATCH", default=True):
         _SYNC_CPU_DISPATCH = False
         return False
     import jax

@@ -19,13 +19,12 @@ class RetriesExceededError(RuntimeError):
 
 
 def env_flag(name: str) -> bool:
-    """Truthy env-var opt-in: 1/true/yes/on (case-insensitive) enable;
-    anything else — including 'false', 'off', '0', unset — disables.
-    The shared semantics for the experimental-kernel flags
-    (MMLSPARK_TPU_PALLAS_HIST / _HIST_SUB / _FLASH)."""
-    import os
-    return os.environ.get(name, "").strip().lower() in (
-        "1", "true", "yes", "on")
+    """Deprecated alias for :func:`mmlspark_tpu.core.env.env_flag`
+    (default-off semantics). New code should import from
+    :mod:`mmlspark_tpu.core.env`, the registered single source of
+    truth for every ``MMLSPARK_TPU_*`` knob."""
+    from mmlspark_tpu.core.env import env_flag as _env_flag
+    return _env_flag(name)
 
 
 def retry_with_backoff(fn: Callable[[], Any], retries: int = 5,

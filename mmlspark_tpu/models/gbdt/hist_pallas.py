@@ -47,7 +47,7 @@ def pallas_histogram_enabled() -> bool:
     """Opt-in until a real-TPU measurement picks the default
     (bench_hist.py measures this kernel against the XLA formulations;
     ROUND4 notes record the decision)."""
-    from mmlspark_tpu.core.utils import env_flag
+    from mmlspark_tpu.core.env import env_flag
     return env_flag("MMLSPARK_TPU_PALLAS_HIST")
 
 
@@ -183,7 +183,7 @@ def pallas_level_histogram(binned, grad, hess, live, local, width, f, b,
         # FORCE_COMPILE: take the Mosaic path even off-TPU — used by
         # the AOT lowering tests to validate the exact on-TPU
         # combination (and for debugging on TPU day)
-        from mmlspark_tpu.core.utils import env_flag
+        from mmlspark_tpu.core.env import env_flag
         interpret = (jax.default_backend() != "tpu"
                      and not env_flag("MMLSPARK_TPU_PALLAS_FORCE_COMPILE"))
     key = (int(width), int(f), int(b), int(block_rows), bool(interpret))

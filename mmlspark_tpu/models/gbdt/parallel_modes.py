@@ -92,7 +92,7 @@ def _check_vma(total_bins: int) -> bool:
     """
     import jax
 
-    from mmlspark_tpu.core.utils import env_flag
+    from mmlspark_tpu.core.env import env_flag
     from mmlspark_tpu.models.gbdt.trainer import (
         resolve_histogram_formulation)
     choice = resolve_histogram_formulation(total_bins, in_shard_map=True,

@@ -29,13 +29,14 @@ params/LightGBMParams.scala; voting/feature parallel variants live in
 from __future__ import annotations
 
 import math
-import os
 from dataclasses import dataclass, field, replace
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from mmlspark_tpu.core.env import (env_flag, env_int, env_override,
+                                   env_raw, env_str)
 from mmlspark_tpu.core.faults import fault_point
 from mmlspark_tpu.models.gbdt import metrics as metrics_mod
 from mmlspark_tpu.models.gbdt import objectives as obj_mod
@@ -217,7 +218,6 @@ def _objective_kwargs(cfg: TrainConfig) -> Dict[str, Any]:
 # ---------------------------------------------------------------------------
 
 _WARNED_BAD_FORMULATION = False
-_WARNED_BAD_CHUNK = False
 _WARNED_SHARD_DOWNGRADE = False
 _WARNED_NATIVE_DOWNGRADE = False
 
@@ -244,8 +244,7 @@ def _native_hist_default_enabled() -> bool:
     ensure_sync_cpu_dispatch's docstring has the full story).
     MMLSPARK_TPU_NATIVE_HIST=0 is the kill switch back to the XLA
     formulations."""
-    v = os.environ.get("MMLSPARK_TPU_NATIVE_HIST", "").strip().lower()
-    if v in ("0", "false", "off", "no"):
+    if not env_flag("MMLSPARK_TPU_NATIVE_HIST", default=True):
         return False
     if not _raw_callback_needed():
         from mmlspark_tpu.core.jax_compat import ensure_sync_cpu_dispatch
@@ -289,7 +288,7 @@ def resolve_histogram_formulation(b: int, in_shard_map: bool = False,
         _WARNED_NATIVE_DOWNGRADE
     if pallas_histogram_enabled() and allow_pallas and b <= 256:
         return "pallas"
-    forced = os.environ.get("MMLSPARK_TPU_HIST_FORMULATION", "").strip()
+    forced = env_str("MMLSPARK_TPU_HIST_FORMULATION", "").strip()
     if forced and forced not in _VALID_FORMULATIONS:
         # a mistyped value silently running the default would mislabel
         # an A/B measurement — warn loudly (once per process)
@@ -545,25 +544,10 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
             # ADVICE r5: a zero-row level must return a zero histogram,
             # not ZeroDivisionError from chunk == 0 in the padding math
             return jnp.zeros((width, f, b, 3), jnp.float32)
-        try:
-            chunk = int(os.environ.get("MMLSPARK_TPU_ONEHOT_CHUNK",
-                                       "4096"))
-            if chunk < 1:
-                raise ValueError
-        except ValueError:
-            # same contract as the formulation knob: a bad value must
-            # not abort (or silently mislabel) a measurement run
-            global _WARNED_BAD_CHUNK
-            if not _WARNED_BAD_CHUNK:
-                _WARNED_BAD_CHUNK = True
-                import warnings
-                warnings.warn(
-                    "MMLSPARK_TPU_ONEHOT_CHUNK="
-                    f"{os.environ['MMLSPARK_TPU_ONEHOT_CHUNK']!r} is "
-                    "not a positive integer; using 4096", stacklevel=2)
-            chunk = 4096
+        # bad values warn once and fall back (core.env contract): they
+        # must not abort — or silently mislabel — a measurement run
+        chunk = env_int("MMLSPARK_TPU_ONEHOT_CHUNK", 4096, minimum=1)
         chunk = min(chunk, n)
-        from mmlspark_tpu.core.utils import env_flag
         op_dtype = (jnp.bfloat16 if env_flag("MMLSPARK_TPU_ONEHOT_BF16")
                     else jnp.float32)
         pad = (-n) % chunk
@@ -1212,9 +1196,8 @@ def resolve_subtract(mode: str, total_bins: int, mesh=None) -> bool:
     compaction is data-dependent)."""
     if mode != "serial":
         return False
-    raw = os.environ.get("MMLSPARK_TPU_HIST_SUB", "").strip()
+    raw = env_str("MMLSPARK_TPU_HIST_SUB", "").strip()
     if raw:
-        from mmlspark_tpu.core.utils import env_flag
         return env_flag("MMLSPARK_TPU_HIST_SUB")
     return resolve_histogram_formulation(
         total_bins, in_shard_map=False, allow_pallas=mesh is None,
@@ -1228,17 +1211,16 @@ def _hist_env_key() -> tuple:
     onehot-under-shard_map parity test compared a cached default step
     against itself)."""
     from mmlspark_tpu.core.jax_compat import ensure_sync_cpu_dispatch
-    from mmlspark_tpu.core.utils import env_flag
     # the sync-dispatch guarantee only gates the pure_callback path
     # (jax >= 0.5); on 0.4.x the raw-callback primitive is used and
     # probing the guard here would needlessly flip the global flag
     sync_state = (True if _raw_callback_needed()
                   else ensure_sync_cpu_dispatch())
-    return (os.environ.get("MMLSPARK_TPU_HIST_FORMULATION", "").strip(),
-            os.environ.get("MMLSPARK_TPU_ONEHOT_CHUNK", "").strip(),
+    return (env_str("MMLSPARK_TPU_HIST_FORMULATION", "").strip(),
+            env_str("MMLSPARK_TPU_ONEHOT_CHUNK", "").strip(),
             env_flag("MMLSPARK_TPU_ONEHOT_BF16"),
-            os.environ.get("MMLSPARK_TPU_HIST_SUB", "").strip(),
-            os.environ.get("MMLSPARK_TPU_NATIVE_HIST", "").strip(),
+            env_str("MMLSPARK_TPU_HIST_SUB", "").strip(),
+            env_str("MMLSPARK_TPU_NATIVE_HIST", "").strip(),
             native_histogram_available(),
             sync_state)
 
@@ -1437,7 +1419,6 @@ def _get_step_fn(num_f, total_bins, cfg, k, n_valid, mode, mesh):
     )
 
     cfg = _loop_only_normalized(cfg)
-    from mmlspark_tpu.core.utils import env_flag
     key = (num_f, total_bins, cfg, k, n_valid, mode, mesh,
            pallas_histogram_enabled(), env_flag("MMLSPARK_TPU_HIST_SUB"),
            _hist_env_key())
@@ -1467,16 +1448,9 @@ def aot_lower_step(cfg: TrainConfig, n: int, num_f: int,
     # host's default backend is cpu, which would otherwise bake the
     # host-callback native histogram into a "tpu" lowering that the
     # real TPU run (backend == tpu) never selects
-    prev_native = os.environ.get("MMLSPARK_TPU_NATIVE_HIST")
-    os.environ["MMLSPARK_TPU_NATIVE_HIST"] = "0"
-    try:
+    with env_override("MMLSPARK_TPU_NATIVE_HIST", "0"):
         return _aot_lower_step_inner(cfg, n, num_f, k, platform,
                                      rows_per_group)
-    finally:
-        if prev_native is None:
-            os.environ.pop("MMLSPARK_TPU_NATIVE_HIST", None)
-        else:
-            os.environ["MMLSPARK_TPU_NATIVE_HIST"] = prev_native
 
 
 def _aot_lower_step_inner(cfg: TrainConfig, n: int, num_f: int, k: int,

@@ -124,5 +124,5 @@ def flash_available() -> bool:
     good XLA fallback rather than first-contact a Mosaic compile."""
     import jax
 
-    from mmlspark_tpu.core.utils import env_flag
+    from mmlspark_tpu.core.env import env_flag
     return jax.default_backend() == "tpu" and env_flag("MMLSPARK_TPU_FLASH")

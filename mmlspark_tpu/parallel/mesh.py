@@ -225,8 +225,7 @@ def _init_with_retries(init_fn, fault_point) -> None:
     into the driver's ServerSocket. Attempts come from
     ``MMLSPARK_TPU_DIST_INIT_RETRIES`` (total tries, default 3);
     mis-use errors (double init, bad arguments) never retry."""
-    import os
-
+    from mmlspark_tpu.core.env import env_int
     from mmlspark_tpu.core.retries import RetryPolicy, with_retries
 
     def attempt():
@@ -241,7 +240,7 @@ def _init_with_retries(init_fn, fault_point) -> None:
         # JAX computations": programming errors, not transient
         return "once" not in msg and "before any" not in msg
 
-    tries = int(os.environ.get("MMLSPARK_TPU_DIST_INIT_RETRIES", "3"))
+    tries = env_int("MMLSPARK_TPU_DIST_INIT_RETRIES", 3, minimum=1)
     with_retries(attempt,
                  policy=RetryPolicy(max_attempts=max(tries, 1),
                                     base_delay=1.0, max_delay=10.0),

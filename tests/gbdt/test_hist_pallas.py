@@ -306,8 +306,8 @@ def test_onehot_tuning_knobs(monkeypatch, extra, rtol):
     bad_chunk = not extra.get("MMLSPARK_TPU_ONEHOT_CHUNK",
                               "1").lstrip("-").isdigit()
     if bad_chunk:
-        from mmlspark_tpu.models.gbdt import trainer as trainer_mod
-        monkeypatch.setattr(trainer_mod, "_WARNED_BAD_CHUNK", False)
+        from mmlspark_tpu.core import env as env_mod
+        env_mod.reset_warnings()
         with pytest.warns(UserWarning, match="ONEHOT_CHUNK"):
             out = np.asarray(_level_histogram(
                 binned, grad, hess, live, local, 8, 7, 31,

@@ -1,0 +1,17 @@
+"""GL001 fixture: typo'd collective axis names (NEVER imported)."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from mmlspark_tpu.core.jax_compat import shard_map
+
+
+def make(mesh):
+    def local_fn(x):
+        total = jax.lax.psum(x, "dq")                 # typo: not dp
+        idx = jax.lax.axis_index(axis_name="rows")    # undeclared axis
+        return total + idx
+
+    return shard_map(local_fn, mesh=mesh,
+                     in_specs=(P("db"),),             # typo: not dp
+                     out_specs=P())

@@ -1,0 +1,23 @@
+"""GL001 fixture: declared axes only (NEVER imported)."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from mmlspark_tpu.core.jax_compat import shard_map
+from mmlspark_tpu.parallel.mesh import DATA_AXIS
+
+LOCAL_AXIS = "fp"
+
+
+def make(mesh, axis_name: str = DATA_AXIS):
+    def local_fn(x):
+        total = jax.lax.psum(x, "dp")                 # declared
+        more = jax.lax.pmean(x, DATA_AXIS)            # mesh constant
+        local = jax.lax.pmax(x, LOCAL_AXIS)           # local constant
+        both = jax.lax.psum(x, ("dp", "fp"))          # tuple of axes
+        param = jax.lax.axis_index(axis_name)         # default = const
+        return total + more + local + both + param
+
+    return shard_map(local_fn, mesh=mesh,
+                     in_specs=(P(DATA_AXIS, None),),
+                     out_specs=P("dp"))

@@ -1,0 +1,18 @@
+"""GL002 fixture: host impurity inside a jitted body (NEVER imported)."""
+
+import os
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    print("tracing")                        # fires at trace time only
+    if os.environ.get("MY_DEBUG"):          # baked in at trace time
+        pass
+    t0 = time.time()                        # trace-time timestamp
+    y = np.sum(x)                           # host numpy on a tracer
+    z = float(x)                            # concretization
+    return y + z + x.sum().item() + t0      # .item() device sync

@@ -1,0 +1,34 @@
+"""GL002 fixture: pure traced bodies + sanctioned callbacks (NEVER
+imported)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mmlspark_tpu.core.faults import fault_point
+
+
+@jax.jit
+def step(x):
+    jax.debug.print("x = {}", x)            # allowed: debug primitive
+    y = jnp.sum(x).astype(np.float32)       # np dtype is static metadata
+    return y
+
+
+@jax.jit
+def step_with_callback(x):
+    def cb(v):
+        # host code by design: np / fault_point are fine in a callback
+        fault_point("native.callback")
+        return np.asarray(v) + 1.0
+
+    out = jax.pure_callback(
+        cb, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+    return out * 2
+
+
+def host_driver(x):
+    # not traced: host impurity is GL002-irrelevant here
+    import time
+    t0 = time.time()
+    return float(np.sum(x)), t0

@@ -1,0 +1,30 @@
+"""GL003 fixture: recompilation hazards (NEVER imported)."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def f(x, opts=[1, 2]):                      # non-hashable static default
+    return x
+
+
+_STEP_CACHE = {}
+
+
+def get_step(lr):
+    return _STEP_CACHE[f"model-{lr}"]       # f-string cache key
+
+
+def put_step(cache_put, lr, fn):
+    return cache_put(f"model-{lr}", fn)     # f-string cache key (call)
+
+
+def build(items):
+    out = []
+    for name in {"a", "b"}:                 # set-literal iteration
+        out.append(name)
+    for name in set(items):                 # set() iteration
+        out.append(name)
+    return out

@@ -1,0 +1,28 @@
+"""GL003 fixture: hashable statics, tuple keys, sorted sets (NEVER
+imported)."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def f(x, opts=(1, 2)):                      # hashable static default
+    return x
+
+
+_STEP_CACHE = {}
+
+
+def get_step(lr, depth):
+    key = (float(lr), int(depth))           # tuple cache key
+    return _STEP_CACHE.get(key)
+
+
+def build(items):
+    out = []
+    for name in sorted({"a", "b"}):         # deterministic order
+        out.append(name)
+    for name in sorted(set(items)):
+        out.append(name)
+    return out

@@ -1,0 +1,10 @@
+"""Fixture faults module (NEVER imported)."""
+
+KNOWN_POINTS = {
+    "a.known": "a point with a call site",
+    "b.orphan": "registered but never threaded through code",
+}
+
+
+def fault_point(name, value=None):
+    return value

@@ -1,0 +1,14 @@
+"""Fixture production module with every GL004 drift (NEVER imported)."""
+
+import os
+
+from pkg.core.env import env_flag
+from pkg.core.faults import fault_point
+
+
+def run():
+    fault_point("a.known")
+    fault_point("c.unregistered")                 # not in KNOWN_POINTS
+    if env_flag("MMLSPARK_TPU_NEW"):              # unregistered + undoc
+        pass
+    return os.environ.get("MMLSPARK_TPU_RAW", "")  # raw access

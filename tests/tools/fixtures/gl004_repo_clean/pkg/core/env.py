@@ -1,0 +1,18 @@
+"""Fixture env registry (NEVER imported)."""
+
+import os
+
+REGISTRY = {}
+
+
+def register(name, kind, default, description):
+    REGISTRY[name] = (kind, default, description)
+    return name
+
+
+REGISTERED = register("MMLSPARK_TPU_REGISTERED", "flag", False,
+                      "a documented, registered knob")
+
+
+def env_flag(name, default=False):
+    return os.environ.get(name, "").strip().lower() in ("1", "true")

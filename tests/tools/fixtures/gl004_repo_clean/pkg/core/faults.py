@@ -1,0 +1,9 @@
+"""Fixture faults module (NEVER imported)."""
+
+KNOWN_POINTS = {
+    "a.known": "a point with a call site",
+}
+
+
+def fault_point(name, value=None):
+    return value

@@ -1,0 +1,9 @@
+"""Fixture production module with no drift (NEVER imported)."""
+
+from pkg.core.env import env_flag
+from pkg.core.faults import fault_point
+
+
+def run():
+    fault_point("a.known")
+    return env_flag("MMLSPARK_TPU_REGISTERED")

@@ -1,0 +1,13 @@
+"""GL005 fixture: hidden-state / unseeded RNG (NEVER imported)."""
+
+import random
+
+import numpy as np
+
+
+def sample(n):
+    rng = np.random.default_rng()           # unseeded: fresh entropy
+    np.random.seed(0)                       # legacy global API
+    vals = np.random.uniform(size=n)        # legacy global API
+    r = random.random()                     # stdlib global RNG
+    return rng, vals, r

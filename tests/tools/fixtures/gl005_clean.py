@@ -1,0 +1,11 @@
+"""GL005 fixture: seeded, replayable randomness (NEVER imported)."""
+
+import random
+
+import numpy as np
+
+
+def sample(n, seed):
+    rng = np.random.default_rng(seed)       # seeded generator
+    jitter = random.Random(seed)            # seeded instance
+    return rng.uniform(size=n), jitter.random()

@@ -1,0 +1,9 @@
+"""GL005 fixture (under a models/ dir): wall-clock in kernel code
+(NEVER imported)."""
+
+import time
+
+
+def train_step(state):
+    started = time.time()                   # wall-clock in trainer code
+    return state, started

@@ -1,0 +1,10 @@
+"""GL005 fixture (under a models/ dir): interval timing via
+perf_counter is fine; wall-clock is not used (NEVER imported)."""
+
+import time
+
+
+def train_step(state):
+    t0 = time.perf_counter()                # interval, not wall-clock
+    elapsed = time.perf_counter() - t0
+    return state, elapsed

@@ -1,0 +1,211 @@
+"""graftlint suite: every GL rule proven against a seeded-violation
+fixture and a clean negative, plus the repo-wide gate (zero findings
+over mmlspark_tpu with an EMPTY baseline) and the CLI contract.
+
+These are tier-1: registry drift (GL004) failing here is the point —
+an undocumented env var or unregistered fault point fails CI.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from tools.graftlint import cli
+from tools.graftlint.core import load_baseline, run_checks
+
+pytestmark = pytest.mark.lint
+
+REPO = Path(__file__).resolve().parents[2]
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+PACKAGE = REPO / "mmlspark_tpu"
+
+
+def lint(paths, select=None, repo_root=None):
+    _, findings = run_checks([Path(p) for p in paths], select=select,
+                             repo_root=repo_root)
+    return findings
+
+
+def messages(findings):
+    return [f.message for f in findings]
+
+
+# --- GL001 ---------------------------------------------------------------
+
+def test_gl001_catches_bad_axes():
+    found = lint([FIXTURES / "gl001_bad.py"], select=["GL001"])
+    msgs = messages(found)
+    assert any("'dq'" in m for m in msgs), msgs
+    assert any("'rows'" in m for m in msgs), msgs
+    assert any("'db'" in m and "PartitionSpec" in m for m in msgs), msgs
+    assert len(found) == 3
+    assert all(f.rule == "GL001" and f.severity == "error"
+               for f in found)
+    assert all(f.hint for f in found)
+
+
+def test_gl001_clean_fixture_passes():
+    assert lint([FIXTURES / "gl001_clean.py"], select=["GL001"]) == []
+
+
+# --- GL002 ---------------------------------------------------------------
+
+def test_gl002_catches_impure_jit_body():
+    found = lint([FIXTURES / "gl002_bad.py"], select=["GL002"])
+    msgs = " | ".join(messages(found))
+    for marker in ("print()", "os.environ", "time.time", "host numpy",
+                   "float() on a traced value", ".item()"):
+        assert marker in msgs, (marker, msgs)
+    assert len(found) == 6
+    assert all(f.rule == "GL002" for f in found)
+
+
+def test_gl002_clean_fixture_passes():
+    # pure bodies, jax.debug.*, pure_callback-wrapped host code and
+    # np-dtype metadata must all be allowed
+    assert lint([FIXTURES / "gl002_clean.py"], select=["GL002"]) == []
+
+
+# --- GL003 ---------------------------------------------------------------
+
+def test_gl003_catches_recompilation_hazards():
+    found = lint([FIXTURES / "gl003_bad.py"], select=["GL003"])
+    msgs = " | ".join(messages(found))
+    assert "non-hashable default" in msgs
+    assert "f-string used as a cache key" in msgs
+    assert "iterating a set" in msgs
+    # 1 static-default + 2 f-string sites + 2 set iterations
+    assert len(found) == 5
+    assert all(f.rule == "GL003" for f in found)
+
+
+def test_gl003_clean_fixture_passes():
+    assert lint([FIXTURES / "gl003_clean.py"], select=["GL003"]) == []
+
+
+# --- GL004 ---------------------------------------------------------------
+
+def test_gl004_catches_registry_drift():
+    root = FIXTURES / "gl004_repo_bad"
+    found = lint([root / "pkg"], select=["GL004"], repo_root=root)
+    msgs = " | ".join(messages(found))
+    assert "'c.unregistered'" in msgs                 # unknown point
+    assert "'b.orphan'" in msgs                       # orphaned entry
+    assert "MMLSPARK_TPU_RAW" in msgs                 # raw os.environ
+    assert "raw os.environ access" in msgs
+    assert "MMLSPARK_TPU_NEW is read but not declared" in msgs
+    assert "MMLSPARK_TPU_NEW is read in code but undocumented" in msgs
+    assert "MMLSPARK_TPU_GONE is documented but never read" in msgs
+    assert all(f.rule == "GL004" for f in found)
+
+
+def test_gl004_clean_fixture_passes():
+    root = FIXTURES / "gl004_repo_clean"
+    assert lint([root / "pkg"], select=["GL004"], repo_root=root) == []
+
+
+# --- GL005 ---------------------------------------------------------------
+
+def test_gl005_catches_rng_hazards():
+    found = lint([FIXTURES / "gl005_bad.py"], select=["GL005"])
+    msgs = " | ".join(messages(found))
+    assert "without a seed" in msgs
+    assert "legacy global numpy RNG" in msgs
+    assert "stdlib global RNG" in msgs
+    # unseeded default_rng + seed() + uniform() + random.random()
+    assert len(found) == 4
+
+
+def test_gl005_catches_wallclock_in_kernel_code():
+    found = lint([FIXTURES / "models" / "gl005_wallclock_bad.py"],
+                 select=["GL005"])
+    assert len(found) == 1
+    assert "wall-clock" in found[0].message
+
+
+def test_gl005_clean_fixtures_pass():
+    assert lint([FIXTURES / "gl005_clean.py"], select=["GL005"]) == []
+    assert lint([FIXTURES / "models" / "gl005_wallclock_clean.py"],
+                select=["GL005"]) == []
+
+
+# --- parse failures ------------------------------------------------------
+
+def test_unparseable_file_reports_gl000(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n", encoding="utf-8")
+    found = lint([bad])
+    assert [f.rule for f in found] == ["GL000"]
+
+
+# --- the repo-wide gate --------------------------------------------------
+
+def test_repo_is_clean_and_fast():
+    """The acceptance gate: zero findings over mmlspark_tpu, no
+    baseline suppressions involved, in well under 10 s."""
+    t0 = time.perf_counter()
+    found = lint([PACKAGE])
+    elapsed = time.perf_counter() - t0
+    assert found == [], [f"{f.location()} {f.rule} {f.message}"
+                         for f in found]
+    assert elapsed < 10.0, f"graftlint took {elapsed:.1f}s"
+
+
+def test_shipped_baseline_is_empty():
+    baseline = REPO / "tools" / "graftlint" / "baseline.json"
+    assert baseline.exists()
+    assert load_baseline(baseline) == set()
+
+
+# --- CLI contract --------------------------------------------------------
+
+def test_cli_exit_codes_and_json(capsys):
+    rc = cli.main(["--json", str(FIXTURES / "gl002_bad.py")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["files_scanned"] == 1
+    assert {f["rule"] for f in out["findings"]} == {"GL002"}
+    assert all(f["fingerprint"] for f in out["findings"])
+
+    rc = cli.main(["--json", str(FIXTURES / "gl002_clean.py")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["findings"] == []
+
+
+def test_cli_missing_path_is_usage_error(capsys):
+    rc = cli.main([str(FIXTURES / "does_not_exist.py")])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_cli_baseline_suppression_roundtrip(tmp_path, capsys):
+    """--write-baseline accepts the current findings; a later run with
+    that baseline exits 0; --no-baseline sees them again."""
+    baseline = tmp_path / "baseline.json"
+    target = str(FIXTURES / "gl002_bad.py")
+
+    rc = cli.main(["--baseline", str(baseline), "--write-baseline",
+                   target])
+    capsys.readouterr()
+    assert rc == 0 and baseline.exists()
+
+    rc = cli.main(["--baseline", str(baseline), target])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "suppressed by baseline" in out
+
+    rc = cli.main(["--baseline", str(baseline), "--no-baseline",
+                   target])
+    capsys.readouterr()
+    assert rc == 1
+
+
+def test_cli_select(capsys):
+    rc = cli.main(["--select", "GL001",
+                   str(FIXTURES / "gl002_bad.py")])
+    capsys.readouterr()
+    assert rc == 0   # GL002 findings exist but only GL001 was run

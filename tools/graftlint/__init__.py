@@ -1,0 +1,22 @@
+"""graftlint — JAX/TPU-aware static analysis for mmlspark_tpu.
+
+AST-based checkers for the invariants the framework's three execution
+paths (Pallas, native callback, XLA) and its fault-tolerance subsystem
+rely on but nothing else machine-checks:
+
+  GL001  collective-axis consistency (psum/pmean/all_gather axis names
+         vs the axes declared in parallel/mesh.py or at the call site)
+  GL002  tracer hygiene (host impurity inside jit/shard_map bodies)
+  GL003  recompilation hazards (non-hashable static args, f-string
+         cache keys, set-iteration feeding traced code)
+  GL004  registry drift (fault points vs KNOWN_POINTS/fuzzing registry;
+         MMLSPARK_TPU_* env vars vs core/env.py registry vs PARAMS.md)
+  GL005  determinism (unseeded RNG, wall-clock in kernel/trainer code)
+
+Run ``python -m tools.graftlint mmlspark_tpu`` (see README "Static
+analysis"). Pure stdlib; never imports the code it scans.
+"""
+
+from tools.graftlint.core import Finding, Project, run_checks  # noqa: F401
+
+__version__ = "0.1.0"

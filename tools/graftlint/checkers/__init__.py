@@ -1,0 +1,21 @@
+"""Checker registry: one module per GL rule."""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def all_checkers() -> List[object]:
+    from tools.graftlint.checkers.gl001_collective_axes import (
+        CollectiveAxisChecker)
+    from tools.graftlint.checkers.gl002_tracer_hygiene import (
+        TracerHygieneChecker)
+    from tools.graftlint.checkers.gl003_recompilation import (
+        RecompilationChecker)
+    from tools.graftlint.checkers.gl004_registry_drift import (
+        RegistryDriftChecker)
+    from tools.graftlint.checkers.gl005_determinism import (
+        DeterminismChecker)
+    return [CollectiveAxisChecker(), TracerHygieneChecker(),
+            RecompilationChecker(), RegistryDriftChecker(),
+            DeterminismChecker()]

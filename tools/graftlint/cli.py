@@ -1,0 +1,107 @@
+"""graftlint command line.
+
+    python -m tools.graftlint mmlspark_tpu            # lint the package
+    python -m tools.graftlint --json path/...         # machine output
+    python -m tools.graftlint --write-baseline ...    # accept current
+
+Exit codes: 0 = clean (no findings beyond the baseline), 1 = new
+findings, 2 = usage error. The default baseline lives next to this
+module (``tools/graftlint/baseline.json``) and is intentionally empty:
+fix findings rather than suppressing them; the baseline exists for the
+rare case where a finding is a true positive for the rule but a false
+positive for the code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from tools.graftlint import core
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="JAX/TPU-aware static analysis for mmlspark_tpu "
+                    "(GL001 collective axes, GL002 tracer hygiene, "
+                    "GL003 recompilation hazards, GL004 registry "
+                    "drift, GL005 determinism)")
+    p.add_argument("paths", nargs="*", default=["mmlspark_tpu"],
+                   help="files or directories to scan "
+                        "(default: mmlspark_tpu)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings as JSON on stdout")
+    p.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
+                   help="suppression file (default: "
+                        "tools/graftlint/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline to suppress every "
+                        "current finding, then exit 0")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rules to run "
+                        "(e.g. GL001,GL004)")
+    p.add_argument("--repo-root", type=Path, default=None,
+                   help="override repo-root discovery (pyproject.toml "
+                        "anchor) for GL004's doc/registry lookups")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    select = (None if not args.select
+              else [s.strip() for s in args.select.split(",")
+                    if s.strip()])
+    paths = [Path(p) for p in args.paths]
+    for p in paths:
+        if not p.exists():
+            print(f"graftlint: path does not exist: {p}",
+                  file=sys.stderr)
+            return 2
+
+    project, findings = core.run_checks(paths, select=select,
+                                        repo_root=args.repo_root)
+
+    if args.write_baseline:
+        core.write_baseline(args.baseline, findings)
+        print(f"graftlint: wrote {len(findings)} suppression(s) to "
+              f"{args.baseline}")
+        return 0
+
+    suppressed: List[core.Finding] = []
+    if not args.no_baseline:
+        known = core.load_baseline(args.baseline)
+        if known:
+            new = [f for f in findings if f.fingerprint not in known]
+            suppressed = [f for f in findings
+                          if f.fingerprint in known]
+            findings = new
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "suppressed": len(suppressed),
+            "files_scanned": len(project.files),
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f"{f.location()}: {f.rule} {f.severity}: "
+                  f"{f.message}")
+            if f.hint:
+                print(f"    hint: {f.hint}")
+        noise = (f" ({len(suppressed)} suppressed by baseline)"
+                 if suppressed else "")
+        print(f"graftlint: {len(findings)} finding(s) in "
+              f"{len(project.files)} file(s){noise}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
