@@ -165,6 +165,19 @@ def main():
         resolve_histogram_formulation,
         resolve_subtract,
     )
+    # graftsan attribution: whether the sanitizer was live during the
+    # timed run (it syncs per boundary, so an accidentally-enabled
+    # sanitizer must be visible in the artifact), plus the measured
+    # per-call cost of a DISABLED boundary guard — the hook is on the
+    # hot path unconditionally, so this number has to stay in the noise
+    from mmlspark_tpu.core import sanitizer
+    probe = np.zeros(4, np.float32)
+    reps = 200_000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        sanitizer.check_finite("bench.probe", probe)
+    san_disabled_ns = ((time.perf_counter() - t0) / reps * 1e9
+                       if not sanitizer.enabled() else None)
     print(json.dumps({
         "metric": "gbdt_fit_throughput_higgs28f_2M" + suffix,
         "value": round(row_trees_per_s, 3),
@@ -174,6 +187,10 @@ def main():
         "hist_formulation": resolve_histogram_formulation(255, warn=False),
         "hist_subtract": resolve_subtract("serial", 255),
         "native_hist_available": native_histogram_available(),
+        "graftsan_enabled": sanitizer.enabled(),
+        "graftsan_disabled_overhead_ns": (
+            round(san_disabled_ns, 1) if san_disabled_ns is not None
+            else None),
     }))
 
 

@@ -103,6 +103,15 @@ FABRIC_ENDPOINT = register(
 FABRIC_TOKEN = register(
     "MMLSPARK_TPU_FABRIC_TOKEN", "str", None,
     "bearer token for the telemetry endpoint")
+SAN = register(
+    "MMLSPARK_TPU_SAN", "flag", False,
+    "=1 enables the graftsan runtime SPMD sanitizer: NaN/Inf "
+    "jit-boundary guards, collective-sequence cross-checks, "
+    "recompilation budget (core/sanitizer.py)")
+SAN_RECOMPILE_BUDGET = register(
+    "MMLSPARK_TPU_SAN_RECOMPILE_BUDGET", "int", 0,
+    "with graftsan enabled: max compilations per process before "
+    "RecompileBudgetExceeded (0 = count only, never raise)")
 
 
 _WARNED: Set[str] = set()

@@ -1,0 +1,332 @@
+"""graftsan — opt-in runtime SPMD sanitizer.
+
+Static analysis (tools/graftlint GL001–GL008) catches what is provable
+from source; graftsan is its runtime twin for the bug classes that only
+manifest with real data on real meshes:
+
+* **NaN/Inf jit-boundary guards** — :func:`check_finite` wraps values
+  crossing a jit boundary (trainer step entry/exit, the native
+  histogram callback, the serving score path) and raises
+  :class:`NonFiniteError` naming the boundary, instead of letting a
+  NaN propagate through an allreduce into every replica's model.
+* **collective-sequence divergence detection** — shard_map bodies call
+  :func:`record_collective` next to each collective; the calls fire at
+  *trace time*, so the recorded sequence is exactly the compiled
+  program's collective protocol, captured once per compilation at zero
+  per-step cost. :func:`step_boundary` hashes the cumulative sequence
+  and, in a multi-process run, cross-checks agreement across ranks — a
+  TSan-style detector for the ``if rank == 0: psum`` deadlock class
+  (GL006's runtime counterpart).
+* **recompilation budget** — the trainer's compile caches report
+  misses through :func:`count_recompile`; a per-process budget
+  (``MMLSPARK_TPU_SAN_RECOMPILE_BUDGET``) turns GL003's static
+  recompilation hazards into a hard runtime signal.
+
+Zero-overhead contract (same pattern as ``faults.fault_point``): every
+entry point reads ONE module-global boolean and returns immediately
+when the sanitizer is off, so production hot paths pay a single
+attribute load + branch. Enable with ``MMLSPARK_TPU_SAN=1`` (or
+:func:`enable` in-process).
+
+Caveat on cross-rank checks: the recorder sees each *process*'s trace,
+so per-process compile-cache asymmetry (one rank tracing a step the
+others had cached from an earlier run) can skew the cumulative hash;
+:func:`reset` at run start, as ``_train_scan`` does, keeps ranks
+comparable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from contextlib import contextmanager
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "SanitizerError", "NonFiniteError", "CollectiveDivergence",
+    "RecompileBudgetExceeded", "enabled", "enable", "disable",
+    "refresh_from_env", "reset", "check_finite", "record_collective",
+    "CollectiveRecorder", "recorder", "use_recorder", "step_boundary",
+    "crosscheck_hashes", "count_recompile", "recompile_count",
+    "set_recompile_budget",
+]
+
+
+class SanitizerError(RuntimeError):
+    """Base class for graftsan diagnostics."""
+
+
+class NonFiniteError(SanitizerError):
+    """A NaN/Inf crossed a guarded jit boundary."""
+
+
+class CollectiveDivergence(SanitizerError):
+    """Ranks disagree on the collective sequence for a step."""
+
+
+class RecompileBudgetExceeded(SanitizerError):
+    """More compilations than the per-process budget allows."""
+
+
+# fast-path flag: every public entry point checks this one module
+# global and returns immediately when the sanitizer is off
+_enabled = False
+
+_lock = threading.Lock()
+_recompiles = 0
+_recompile_budget = 0          # 0 = count only, never raise
+_recent_recompiles: List[str] = []
+_RECENT_KEEP = 8
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def refresh_from_env() -> None:
+    """Re-read ``MMLSPARK_TPU_SAN`` / ``MMLSPARK_TPU_SAN_RECOMPILE_BUDGET``
+    (call after changing them in-process, e.g. under ``env_override``)."""
+    global _enabled, _recompile_budget
+    from mmlspark_tpu.core.env import (SAN, SAN_RECOMPILE_BUDGET,
+                                       env_flag, env_int)
+    _enabled = env_flag(SAN, False)
+    _recompile_budget = env_int(SAN_RECOMPILE_BUDGET, 0, minimum=0)
+
+
+def reset() -> None:
+    """Clear recorded state (collective events, recompile counter)
+    without touching the enabled flag. Run-start and test hook."""
+    global _recompiles
+    with _lock:
+        _recompiles = 0
+        _recent_recompiles.clear()
+    _recorder.clear()
+
+
+# --- NaN/Inf jit-boundary guards -------------------------------------------
+
+def check_finite(boundary: str, value: Any) -> Any:
+    """Return ``value`` unchanged; when the sanitizer is enabled, raise
+    :class:`NonFiniteError` naming ``boundary`` if any floating-point
+    array leaf contains NaN or Inf. Disabled cost: one boolean check."""
+    if not _enabled:
+        return value
+    bad = _find_non_finite(value, path="value")
+    if bad is not None:
+        path, nan_count, inf_count, shape = bad
+        raise NonFiniteError(
+            f"graftsan: non-finite values at jit boundary "
+            f"{boundary!r}: {nan_count} NaN / {inf_count} Inf in "
+            f"{path} (shape {shape}); enable the fault log or bisect "
+            f"with MMLSPARK_TPU_SAN=1 upstream of this boundary")
+    return value
+
+
+def _find_non_finite(value: Any, path: str
+                     ) -> Optional[Tuple[str, int, int, tuple]]:
+    import numpy as np
+    if value is None or isinstance(value, (bool, int, str, bytes)):
+        return None
+    if isinstance(value, float):
+        if value != value or value in (float("inf"), float("-inf")):
+            return (path, int(value != value), int(value == value), ())
+        return None
+    if isinstance(value, dict):
+        for k, v in value.items():
+            hit = _find_non_finite(v, f"{path}[{k!r}]")
+            if hit is not None:
+                return hit
+        return None
+    if isinstance(value, (list, tuple)):
+        for i, v in enumerate(value):
+            hit = _find_non_finite(v, f"{path}[{i}]")
+            if hit is not None:
+                return hit
+        return None
+    dtype = getattr(value, "dtype", None)
+    if dtype is None:
+        return None
+    try:
+        kind = np.dtype(dtype).kind
+    except TypeError:
+        return None    # extension dtypes (e.g. jax PRNG keys): not float
+    if kind not in "fc":
+        return None
+    arr = np.asarray(value)
+    finite = np.isfinite(arr)
+    if finite.all():
+        return None
+    nan_count = int(np.isnan(arr).sum())
+    inf_count = int(np.isinf(arr).sum())
+    return (path, nan_count, inf_count, tuple(arr.shape))
+
+
+# --- collective-sequence recorder ------------------------------------------
+
+class CollectiveRecorder:
+    """Accumulates (op, axis, shape, dtype) collective events for one
+    simulated rank/process; swappable via :func:`use_recorder` so tests
+    can trace per-rank programs against separate recorders."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.events: List[Tuple[str, str, tuple, str]] = []
+
+    def record(self, op: str, axis: Any, shape: Any = None,
+               dtype: Any = None) -> None:
+        event = (str(op), _axis_str(axis),
+                 tuple(shape) if shape is not None else (),
+                 str(dtype) if dtype is not None else "")
+        with self._lock:
+            self.events.append(event)
+
+    def sequence_hash(self) -> str:
+        with self._lock:
+            blob = repr(self.events).encode("utf-8")
+        return hashlib.sha1(blob).hexdigest()[:16]
+
+    def clear(self) -> None:
+        with self._lock:
+            self.events.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self.events)
+
+
+def _axis_str(axis: Any) -> str:
+    if isinstance(axis, (tuple, list)):
+        return ",".join(str(a) for a in axis)
+    return str(axis)
+
+
+_recorder = CollectiveRecorder()
+_active_recorder: Optional[CollectiveRecorder] = None
+
+
+def recorder() -> CollectiveRecorder:
+    return _active_recorder if _active_recorder is not None else _recorder
+
+
+@contextmanager
+def use_recorder(r: CollectiveRecorder) -> Iterator[CollectiveRecorder]:
+    """Route :func:`record_collective` to ``r`` inside the block —
+    how tests simulate two ranks tracing (possibly divergent)
+    programs in one process."""
+    global _active_recorder
+    prev = _active_recorder
+    _active_recorder = r
+    try:
+        yield r
+    finally:
+        _active_recorder = prev
+
+
+def record_collective(op: str, axis: Any, shape: Any = None,
+                      dtype: Any = None) -> None:
+    """Instrumentation hook placed next to each collective inside a
+    shard_map body. Executes at *trace time* (it is host code), so it
+    fires once per compilation and records exactly the collective
+    protocol the compiled program will follow — zero per-step cost."""
+    if not _enabled:
+        return
+    recorder().record(op, axis, shape, dtype)
+
+
+def crosscheck_hashes(hashes: Sequence[str],
+                      tag: str = "step") -> None:
+    """Pure agreement check over per-rank sequence hashes: raises
+    :class:`CollectiveDivergence` naming the first divergent rank."""
+    if not hashes:
+        return
+    reference = hashes[0]
+    for rank, h in enumerate(hashes):
+        if h != reference:
+            raise CollectiveDivergence(
+                f"graftsan: collective-sequence divergence at "
+                f"{tag!r}: rank {rank} hash {h} != rank 0 hash "
+                f"{reference} — ranks compiled different collective "
+                f"protocols (the `if rank == 0: psum` deadlock class); "
+                f"diff the ranks' recorded (op, axis, shape, dtype) "
+                f"sequences")
+
+
+def step_boundary(tag: str = "step") -> str:
+    """Hash the cumulative recorded collective sequence; in a
+    multi-process run, all-gather the hashes and raise on divergence.
+    Returns the local hash ('' when the sanitizer is off)."""
+    if not _enabled:
+        return ""
+    h = recorder().sequence_hash()
+    try:
+        import jax
+        nproc = jax.process_count()
+    except Exception:  # jax not importable in pure-host tooling
+        return h
+    if nproc <= 1:
+        return h
+    gathered = _allgather_hash(h, nproc)
+    if gathered is not None:
+        crosscheck_hashes(gathered, tag=tag)
+    return h
+
+
+def _allgather_hash(h: str, nproc: int) -> Optional[List[str]]:
+    try:
+        import numpy as np
+        from jax.experimental import multihost_utils
+        local = np.frombuffer(bytes.fromhex(h.ljust(16, "0")),
+                              dtype=np.uint8)
+        gathered = np.asarray(
+            multihost_utils.process_allgather(local))
+        return [bytes(row).hex()[:16] for row in
+                gathered.reshape(nproc, -1)]
+    except Exception:
+        return None   # no distributed runtime: local-only check
+
+
+# --- recompilation budget ---------------------------------------------------
+
+def count_recompile(description: str) -> None:
+    """Compile caches report misses here; with a budget set, the
+    (budget+1)-th miss raises :class:`RecompileBudgetExceeded` listing
+    the most recent compilation descriptions."""
+    if not _enabled:
+        return
+    global _recompiles
+    with _lock:
+        _recompiles += 1
+        _recent_recompiles.append(description[:200])
+        del _recent_recompiles[:-_RECENT_KEEP]
+        count = _recompiles
+        budget = _recompile_budget
+        recent = list(_recent_recompiles)
+    if budget and count > budget:
+        raise RecompileBudgetExceeded(
+            f"graftsan: {count} compilations exceed the per-process "
+            f"budget of {budget} (MMLSPARK_TPU_SAN_RECOMPILE_BUDGET); "
+            f"recent: {recent} — look for unstable cache keys (GL003) "
+            f"or shape churn")
+
+
+def recompile_count() -> int:
+    return _recompiles
+
+
+def set_recompile_budget(budget: int) -> None:
+    global _recompile_budget
+    _recompile_budget = max(0, int(budget))
+
+
+# arm from the environment at import, like faults.arm_from_env()
+refresh_from_env()

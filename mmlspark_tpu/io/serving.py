@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from mmlspark_tpu.core import sanitizer
 from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.core.faults import fault_point
 from mmlspark_tpu.core.logging_utils import logger, warn_once
@@ -343,6 +344,10 @@ class ServingServer:
         out = self.model.transform(df)
         reply_cols = [self.reply_col] if self.reply_col else \
             [c for c in out.columns if c not in df.columns] or out.columns
+        # score-path jit-boundary guard: a NaN prediction here would
+        # otherwise serialize into a client-visible JSON "NaN"
+        sanitizer.check_finite("serving.score",
+                               {c: out.col(c) for c in reply_cols})
         for i, p in enumerate(batch):
             reply = {}
             for c in reply_cols:

@@ -28,6 +28,7 @@ from typing import Callable
 
 import numpy as np
 
+from mmlspark_tpu.core.sanitizer import record_collective
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS
 
 
@@ -149,6 +150,7 @@ def make_build_tree_voting(num_features: int, total_bins: int, cfg,
 
         root = jnp.stack([jnp.sum(grad * valid), jnp.sum(hess * valid),
                           jnp.sum(valid)])
+        record_collective("psum", DATA_AXIS, root.shape, root.dtype)
         root = jax.lax.psum(root, DATA_AXIS)
         rv, _ = leaf_objective(root[0], root[1])
         node_value = node_value.at[0].set(rv)
@@ -170,6 +172,8 @@ def make_build_tree_voting(num_features: int, total_bins: int, cfg,
             per_feat = jnp.max(local_gain, axis=2)          # (width, f)
             _, top_feats = jax.lax.top_k(per_feat, min(top_k, f))
             votes = jnp.sum(jax.nn.one_hot(top_feats, f), axis=1)
+            record_collective("psum", DATA_AXIS, votes.shape,
+                              votes.dtype)
             votes = jax.lax.psum(votes, DATA_AXIS)          # (width, f)
             # deterministic tie-break toward lower feature ids
             votes = votes - jnp.arange(f)[None, :] * 1e-6
@@ -178,6 +182,8 @@ def make_build_tree_voting(num_features: int, total_bins: int, cfg,
             # ---- reduce ONLY candidate histograms ----------------------
             hist_cand = jnp.take_along_axis(
                 hist, cand_feats[:, :, None, None], axis=1)
+            record_collective("psum", DATA_AXIS, hist_cand.shape,
+                              hist_cand.dtype)
             hist_cand = jax.lax.psum(hist_cand, DATA_AXIS)
 
             gain_cand, cum_cand = _split_gains(hist_cand, leaf_objective,
@@ -314,11 +320,17 @@ def make_build_tree_feature_parallel(num_features: int, total_bins: int,
             loc_bin = (loc_fb % b).astype(jnp.int32)
 
             # ---- combine per-shard bests (tiny all-gather) -------------
+            record_collective("all_gather", FEATURE_AXIS,
+                              loc_gain.shape, loc_gain.dtype)
             gains_all = jax.lax.all_gather(loc_gain, FEATURE_AXIS)  # (P, w)
             winner = jnp.argmax(gains_all, axis=0)                  # (w,)
             best_gain = jnp.max(gains_all, axis=0)
             i_am_winner = winner == shard
             zero = jnp.zeros_like(loc_feat)
+            record_collective("psum", FEATURE_AXIS, loc_feat.shape,
+                              loc_feat.dtype)
+            record_collective("psum", FEATURE_AXIS, loc_bin.shape,
+                              loc_bin.dtype)
             best_feat = jax.lax.psum(
                 jnp.where(i_am_winner, loc_feat, zero), FEATURE_AXIS)
             best_bin = jax.lax.psum(
@@ -344,6 +356,10 @@ def make_build_tree_feature_parallel(num_features: int, total_bins: int,
             left_loc = jnp.take_along_axis(
                 cum_best, loc_bin[:, None, None], axis=1)[:, 0, :]
             tot_loc = cum_best[:, -1, :]
+            record_collective("psum", FEATURE_AXIS, left_loc.shape,
+                              left_loc.dtype)
+            record_collective("psum", FEATURE_AXIS, tot_loc.shape,
+                              tot_loc.dtype)
             left_stats = jax.lax.psum(
                 jnp.where(i_am_winner[:, None], left_loc, 0.0), FEATURE_AXIS)
             tot_stats = jax.lax.psum(
@@ -370,6 +386,8 @@ def make_build_tree_feature_parallel(num_features: int, total_bins: int,
                 1)[:, 0]
             go_left_vote = jnp.where(
                 mine, (nbin_loc <= best_bin[local]).astype(jnp.int32), 0)
+            record_collective("psum", FEATURE_AXIS,
+                              go_left_vote.shape, go_left_vote.dtype)
             go_left = jax.lax.psum(go_left_vote, FEATURE_AXIS) > 0
             nsplit = do_split[local]
             child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
@@ -380,6 +398,9 @@ def make_build_tree_feature_parallel(num_features: int, total_bins: int,
         # every shard computed identical values (all cross-shard state went
         # through psum); pmax is an identity that marks them fp-invariant
         # so out_specs=P() typechecks
+        for v in (split_feature, threshold_bin, node_value,
+                  node_count):
+            record_collective("pmax", FEATURE_AXIS, v.shape, v.dtype)
         return tuple(jax.lax.pmax(v, FEATURE_AXIS) for v in
                      (split_feature, threshold_bin, node_value, node_count))
 

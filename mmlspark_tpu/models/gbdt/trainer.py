@@ -37,6 +37,7 @@ import numpy as np
 
 from mmlspark_tpu.core.env import (env_flag, env_int, env_override,
                                    env_raw, env_str)
+from mmlspark_tpu.core import sanitizer
 from mmlspark_tpu.core.faults import fault_point
 from mmlspark_tpu.models.gbdt import metrics as metrics_mod
 from mmlspark_tpu.models.gbdt import objectives as obj_mod
@@ -1053,6 +1054,7 @@ def _cache_put(cache, key, factory):
     if key not in cache:
         if len(cache) >= _CACHE_LIMIT:
             cache.clear()  # drop all compiled fns; next calls recompile
+        sanitizer.count_recompile(repr(key))
         cache[key] = factory()
     return cache[key]
 
@@ -1795,6 +1797,11 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
     import jax
     import jax.numpy as jnp
 
+    # graftsan: fresh collective/recompile log per run (keeps ranks'
+    # cumulative sequence hashes comparable) BEFORE the compile caches
+    # run, so their misses are counted against this run's budget
+    sanitizer.reset()
+
     n_valid = len(valid_states)
     mode = _resolve_mode(cfg, mesh)
     step_fn = _get_step_fn(num_f, total_bins, cfg, k, n_valid, mode, mesh)
@@ -1818,6 +1825,10 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
         } for vs in valid_states),
     }
     carry = (raw, tuple(vs["raw"] for vs in valid_states))
+
+    # entry guard: a NaN entering here would otherwise surface 100
+    # iterations later as a mysteriously constant model
+    sanitizer.check_finite("gbdt.train_scan.entry", data)
 
     # metric record layout must match the step body's stacking order
     labels_order = []
@@ -1846,7 +1857,12 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
             fault_point("allreduce")
             stacked = jnp.stack([outs[i][4] for i in
                                  range(len(met_host), upto)])
-            met_host.extend(np.asarray(jax.device_get(stacked)))
+            rows = np.asarray(jax.device_get(stacked))
+            met_host.extend(rows)
+            # first host sync after the reduced metrics land: guard
+            # them and cross-check the collective-sequence hash here
+            sanitizer.check_finite("gbdt.metrics_sync", rows)
+            sanitizer.step_boundary("gbdt.metrics_sync")
 
     vidx = (labels_order.index(f"valid0_{metric_list[0][0]}")
             if has_es else -1)
@@ -1927,6 +1943,8 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
     has_cat = len(kept[0]) > 5
     with measures.phase("training"):
         jax.block_until_ready(carry)  # drain async dispatches
+    # jit-boundary exit guard: raw scores after the last fused step
+    sanitizer.check_finite("gbdt.train_scan.exit", carry)
     with measures.phase("validation"):
         sync_metrics_through(stop_after)
         # single batched transfer of all kept trees
@@ -1971,6 +1989,11 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
     cached across calls."""
     import jax
     import jax.numpy as jnp
+
+    sanitizer.reset()
+    sanitizer.check_finite(
+        "gbdt.train_loop.entry",
+        (labels_d, weights_d, raw, row_valid))
 
     is_dart = cfg.boosting_type == "dart"
     is_rf = cfg.boosting_type == "rf"

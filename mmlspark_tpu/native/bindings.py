@@ -10,6 +10,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from mmlspark_tpu.core import sanitizer
 from mmlspark_tpu.core.faults import fault_point
 from mmlspark_tpu.core.logging_utils import logger
 
@@ -179,10 +180,12 @@ def level_histogram(binned: np.ndarray, grad: np.ndarray,
         # injection point on the histogram RESULT: arming corrupt here
         # proves a bad data-plane answer changes the model (so parity
         # tests really exercise this kernel); delay simulates a slow one
-        return fault_point("gbdt.level_hist", out)
+        return sanitizer.check_finite(
+            "gbdt.level_hist", fault_point("gbdt.level_hist", out))
     out = np.zeros((width, f, n_bins, 3), np.float32)
     if n == 0:
-        return fault_point("gbdt.level_hist", out)
+        return sanitizer.check_finite(
+            "gbdt.level_hist", fault_point("gbdt.level_hist", out))
     idx_base = local.astype(np.int64) * n_bins
     chans = (grad * live, hess * live, live)
     for j in range(f):
@@ -191,7 +194,8 @@ def level_histogram(binned: np.ndarray, grad: np.ndarray,
             out[:, j, :, c] = np.bincount(
                 idx, weights=w, minlength=width * n_bins
             ).reshape(width, n_bins).astype(np.float32)
-    return fault_point("gbdt.level_hist", out)
+    return sanitizer.check_finite(
+        "gbdt.level_hist", fault_point("gbdt.level_hist", out))
 
 
 def load_csv(path: str, skip_header: bool = True

@@ -30,6 +30,7 @@ from __future__ import annotations
 from functools import partial
 from typing import Optional
 
+from mmlspark_tpu.core.sanitizer import record_collective
 from mmlspark_tpu.parallel.mesh import SEQUENCE_AXIS
 
 _NEG_INF = -1e30
@@ -205,6 +206,8 @@ def ring_attention(q, k, v, mesh, causal: bool = False,
                 causal=causal, scale=scale)
             # rotate KV to the next device (neighbor exchange on ICI)
             perm = [(j, (j + 1) % sp) for j in range(sp)]
+            record_collective("ppermute", axis_name, kb.shape, kb.dtype)
+            record_collective("ppermute", axis_name, vb.shape, vb.dtype)
             kb = jax.lax.ppermute(kb, axis_name, perm)
             vb = jax.lax.ppermute(vb, axis_name, perm)
             return out, row_max, row_sum, kb, vb
@@ -245,10 +248,12 @@ def ulysses_attention(q, k, v, mesh, causal: bool = False,
     def local(qc, kc, vc):
         # (b, n/P, h, d) --all_to_all--> (b, n, h/P, d)
         def seq_to_heads(x):
+            record_collective("all_to_all", axis_name, x.shape, x.dtype)
             return jax.lax.all_to_all(x, axis_name, split_axis=2,
                                       concat_axis=1, tiled=True)
 
         def heads_to_seq(x):
+            record_collective("all_to_all", axis_name, x.shape, x.dtype)
             return jax.lax.all_to_all(x, axis_name, split_axis=1,
                                       concat_axis=2, tiled=True)
 

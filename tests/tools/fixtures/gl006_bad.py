@@ -1,0 +1,50 @@
+"""GL006 fixture: rank/data-divergent collectives (NEVER imported)."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DATA_AXIS = "dp"
+
+
+@jax.jit
+def rank_gated_psum(x):
+    # collective reachable only on rank 0: every other rank deadlocks
+    if jax.process_index() == 0:
+        x = lax.psum(x, DATA_AXIS)
+    return x
+
+
+@jax.jit
+def rank_loop_collective(x):
+    # loop trip count differs per rank -> mismatched collective counts
+    shard = lax.axis_index(DATA_AXIS)
+    while shard > 0:
+        x = lax.all_gather(x, DATA_AXIS)
+        shard = shard - 1
+    return x
+
+
+@jax.jit
+def data_dependent_collective(x, threshold):
+    # predicate on a traced argument: fails to trace, and under host
+    # dispatch each rank branches on its own shard
+    total = jnp.sum(x)
+    if total > threshold:
+        x = lax.psum(x, DATA_AXIS)
+    return x
+
+
+USE_TWO_PHASE = True
+
+
+@jax.jit
+def mismatched_branches(x):
+    # both arms collect under a trace-static predicate, but disagree
+    # on the protocol (warning)
+    if USE_TWO_PHASE:
+        x = lax.psum(x, DATA_AXIS)
+        x = lax.all_gather(x, DATA_AXIS)
+    else:
+        x = lax.all_gather(x, DATA_AXIS)
+    return x

@@ -1,0 +1,66 @@
+"""GL006 clean fixture: legal collective patterns (NEVER imported).
+
+Everything here must produce zero findings: rank identity used as
+*data*, shape-derived (trace-static) predicates, static loop bounds,
+identical collective sequences on both arms of a rank-gated branch,
+and a version-gated one-sided wrapper outside any traced context.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DATA_AXIS = "dp"
+DEPTH = 4
+
+
+@jax.jit
+def rank_as_data(x):
+    # axis_index flowing through arithmetic/where is fine: every rank
+    # still executes the same collectives
+    shard = lax.axis_index(DATA_AXIS)
+    mask = jnp.where(shard == 0, 1.0, 0.0)
+    return lax.psum(x * mask, DATA_AXIS)
+
+
+@jax.jit
+def shape_predicate(x):
+    # .shape reads are trace-static even on tracers
+    if x.shape[0] % 2:
+        x = jnp.pad(x, ((0, 1),))
+    return lax.psum(x, DATA_AXIS)
+
+
+@jax.jit
+def static_loop(x):
+    for _ in range(DEPTH):
+        x = lax.psum(x, DATA_AXIS)
+    return x
+
+
+@jax.jit
+def agreeing_branches(x):
+    # rank-tainted predicate, but both arms run the identical
+    # collective sequence: no divergence
+    if jax.process_index() == 0:
+        y = lax.psum(x * 2.0, DATA_AXIS)
+    else:
+        y = lax.psum(x, DATA_AXIS)
+    return y
+
+
+def version_gated_wrapper(x, axes):
+    # host-side compat shim (cf. core/jax_compat.py): the one-sided
+    # branch is gated on a getattr probe, not on rank or data
+    pcast = getattr(lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, tuple(axes), to="varying")
+
+
+@jax.jit
+def none_gate(x, weights=None):
+    # `is None` on an argument is resolved at trace time
+    if weights is None:
+        weights = jnp.ones_like(x)
+    return lax.psum(x * weights, DATA_AXIS)

@@ -1,0 +1,34 @@
+"""GL007 fixture: int32 overflow + f64 narrowing (NEVER imported)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def overflow_arange(binned, grad, num_features, num_bins):
+    # n * F * B flat iota: overflows int32 beyond ~2**31 total cells
+    n = binned.shape[0]
+    flat = jnp.arange(n * num_features * num_bins, dtype=jnp.int32)
+    return flat
+
+
+def overflow_segment_ids(binned, grad, f, b):
+    # the classic flat histogram index: rows * F * B + ...
+    rows = jnp.arange(binned.shape[0])
+    idx = rows * (f * b) + binned[:, 0]
+    return jax.ops.segment_sum(grad, idx, num_segments=f * b)
+
+
+def overflow_scatter(hist, grad, binned, f, b):
+    n = binned.shape[0]
+    flat = (jnp.arange(n) * f * b + binned[:, 0]).reshape(-1)
+    return hist.at[flat].add(grad)
+
+
+step = jax.jit(lambda v: v * 2.0)
+
+
+def narrowed_f64(x):
+    # float64 host accumulate, silently narrowed at the jit boundary
+    acc = np.asarray(x, np.float64)
+    return step(acc)

@@ -1,0 +1,41 @@
+"""GL007 clean fixture: all patterns here are legal (NEVER imported).
+
+Two-factor shape products (bin math), node-local×bin indices that are
+bounded by the histogram width rather than the row count, explicitly
+int64-widened flat indices, and explicitly narrowed float64 values.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def two_factor_bin_math(binned, r):
+    # nb * r stays far below 2**31: the rule targets the three-factor
+    # rows*F*B class, not every shape product
+    nb = binned.shape[0]
+    return jnp.arange(nb * r, dtype=jnp.int32)
+
+
+def node_local_index(local, binned, grad, f, b, width):
+    # the trainer's histogram index: `local` is a node id bounded by
+    # the tree width, not a row count — width*f*b cells fit int32
+    base = (local[:, None] * f + jnp.arange(f)[None, :]) * b
+    idx = (base + binned).reshape(-1)
+    return jax.ops.segment_sum(grad, idx, num_segments=width * f * b)
+
+
+def widened_index(binned, grad, f, b):
+    # explicit int64 widening is exactly the fix GL007 asks for
+    rows = jnp.arange(binned.shape[0]).astype(jnp.int64)
+    idx = rows * f * b + binned[:, 0]
+    return jax.ops.segment_sum(grad, idx, num_segments=int(f) * int(b))
+
+
+step = jax.jit(lambda v: v * 2.0)
+
+
+def narrowed_explicitly(x):
+    acc = np.asarray(x, np.float64)
+    acc32 = acc.astype(np.float32)   # intentional, visible narrowing
+    return step(acc32)

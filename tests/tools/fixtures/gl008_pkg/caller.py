@@ -1,0 +1,23 @@
+"""GL008 fixture: shard_map body calling helpers across a module
+boundary (NEVER imported)."""
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from tests.tools.fixtures.gl008_pkg import helpers
+
+DATA_AXIS = "dp"
+
+
+def build(mesh):
+    def local_fn(x, g):
+        # the axis literal is wrong, but only the helper sees it used
+        # in a collective — GL001 alone cannot connect the two
+        y = helpers.reduce_shard(x, "dq")
+        z = helpers.summarize(y, g)
+        return z
+
+    return shard_map(local_fn, mesh=mesh,
+                     in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+                     out_specs=P(DATA_AXIS))

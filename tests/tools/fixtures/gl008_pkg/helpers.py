@@ -1,0 +1,19 @@
+"""GL008 fixture helpers: hazards only visible through the call chain
+(NEVER imported)."""
+
+import os
+
+import numpy as np
+from jax import lax
+
+
+def reduce_shard(x, axis):
+    # the collective itself is fine; the axis comes from the caller
+    return lax.psum(x, axis)
+
+
+def summarize(y, g):
+    if os.environ.get("FIXTURE_DEBUG"):     # baked in at trace time
+        pass
+    total = np.sum(g)                       # host numpy on a tracer
+    return y * total

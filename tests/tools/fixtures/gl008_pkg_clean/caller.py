@@ -1,0 +1,19 @@
+"""GL008 clean fixture: helpers reached from a shard_map body doing
+only legal things (NEVER imported)."""
+
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from tests.tools.fixtures.gl008_pkg_clean import helpers
+
+DATA_AXIS = "dp"
+
+
+def build(mesh, block):
+    def local_fn(x, g):
+        y = helpers.reduce_shard(x, DATA_AXIS)
+        return helpers.blockwise(y, g, block)
+
+    return shard_map(local_fn, mesh=mesh,
+                     in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+                     out_specs=P(DATA_AXIS))

@@ -209,3 +209,174 @@ def test_cli_select(capsys):
                    str(FIXTURES / "gl002_bad.py")])
     capsys.readouterr()
     assert rc == 0   # GL002 findings exist but only GL001 was run
+
+
+# --- GL006 ---------------------------------------------------------------
+
+def test_gl006_catches_divergent_collectives():
+    found = lint([FIXTURES / "gl006_bad.py"], select=["GL006"])
+    msgs = messages(found)
+    errors = [f for f in found if f.severity == "error"]
+    warns = [f for f in found if f.severity == "warning"]
+    assert len(errors) == 3 and len(warns) == 1, msgs
+    assert any("'psum'" in m and "'if' predicate tainted by rank "
+               "identity" in m for m in msgs), msgs
+    assert any("'all_gather'" in m and "'while' predicate" in m
+               for m in msgs), msgs
+    assert any("control-dependent on traced data" in m
+               for m in msgs), msgs
+    assert any("mismatched collective sequences" in m
+               for m in msgs), msgs
+    assert all(f.rule == "GL006" and f.hint for f in found)
+
+
+def test_gl006_clean_fixture_passes():
+    # rank-as-data through jnp.where, shape predicates, static loops,
+    # branch-agreeing collectives and `is None` gates are all legal
+    assert lint([FIXTURES / "gl006_clean.py"], select=["GL006"]) == []
+
+
+def test_gl006_no_false_positive_on_real_builders():
+    # the shipped shard_map builders (voting + feature-parallel) are
+    # the no-false-positive acceptance bar for the divergence rule
+    assert lint([PACKAGE / "models" / "gbdt" / "parallel_modes.py"],
+                select=["GL006"]) == []
+
+
+# --- GL007 ---------------------------------------------------------------
+
+def test_gl007_catches_narrow_index_products():
+    found = lint([FIXTURES / "gl007_bad.py"], select=["GL007"])
+    msgs = messages(found)
+    assert len(found) == 4, msgs
+    overflow = [m for m in msgs if "overflows int32" in m]
+    assert len(overflow) == 3, msgs
+    assert any("arange" in m for m in overflow)
+    assert any("segment_sum" in m for m in overflow)
+    assert any(".at[flat].add" in m for m in overflow)
+    assert any("silently narrowed to float32" in m and "'step'" in m
+               for m in msgs), msgs
+    assert all(f.rule == "GL007" for f in found)
+
+
+def test_gl007_clean_fixture_passes():
+    # 2-factor products, node-local indexing, int64-widened products
+    # and explicit float32 casts must all pass
+    assert lint([FIXTURES / "gl007_clean.py"], select=["GL007"]) == []
+
+
+# --- GL008 ---------------------------------------------------------------
+
+def test_gl008_follows_helpers_across_modules():
+    found = lint([FIXTURES / "gl008_pkg"], select=["GL008"])
+    msgs = messages(found)
+    assert len(found) == 3, msgs
+    assert any("axis name 'dq'" in m and "parameter 'axis'" in m
+               for m in msgs), msgs
+    assert any("os.environ" in m for m in msgs), msgs
+    assert any("numpy.sum" in m for m in msgs), msgs
+    # every finding names the call chain from the traced root
+    assert all("call chain" in m for m in msgs), msgs
+    assert all(f.rule == "GL008" for f in found)
+
+
+def test_gl008_clean_package_passes():
+    # module-constant axis names and host numpy on static shape math
+    # in helpers are legal
+    assert lint([FIXTURES / "gl008_pkg_clean"], select=["GL008"]) == []
+
+
+# --- inline suppression --------------------------------------------------
+
+def test_inline_suppression_drops_annotated_finding(tmp_path):
+    src = FIXTURES / "gl006_bad.py"
+    lines = src.read_text(encoding="utf-8").splitlines()
+    baseline = lint([src], select=["GL006"])
+    target = baseline[0]
+    lines[target.line - 1] += "  # graftlint: disable=GL006"
+    patched = tmp_path / "patched.py"
+    patched.write_text("\n".join(lines) + "\n", encoding="utf-8")
+    found = lint([patched], select=["GL006"])
+    assert len(found) == len(baseline) - 1
+    assert target.line not in {f.line for f in found}
+
+
+def test_inline_suppression_all_and_multiple_codes(tmp_path):
+    body = (
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    print(x)  # graftlint: disable=GL002,GL005\n"
+        "    print(x)  # graftlint: disable=all\n"
+        "    return x\n")
+    p = tmp_path / "s.py"
+    p.write_text(body, encoding="utf-8")
+    assert lint([p], select=["GL002"]) == []
+
+
+def test_inline_suppression_unknown_code_warns(tmp_path):
+    p = tmp_path / "s.py"
+    p.write_text("x = 1  # graftlint: disable=GL099\n",
+                 encoding="utf-8")
+    found = lint([p])
+    assert len(found) == 1
+    f = found[0]
+    assert (f.rule, f.severity) == ("GL000", "warning")
+    assert "unknown rule code 'GL099'" in f.message
+
+
+# --- --changed mode ------------------------------------------------------
+
+def _init_git_repo(path, files):
+    import subprocess
+    def git(*a):
+        subprocess.run(["git", *a], cwd=path, check=True,
+                       capture_output=True)
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    for rel, body in files.items():
+        fp = path / rel
+        fp.parent.mkdir(parents=True, exist_ok=True)
+        fp.write_text(body, encoding="utf-8")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    return git
+
+
+def test_cli_changed_scans_only_modified_files(tmp_path, capsys):
+    bad = (FIXTURES / "gl002_bad.py").read_text(encoding="utf-8")
+    _init_git_repo(tmp_path, {"a.py": "x = 1\n", "b.py": bad})
+    # nothing modified: exit 0 without scanning the seeded violations
+    rc = cli.main(["--changed", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "no changed python files" in out
+    # touch the bad file: --changed must now surface its findings
+    (tmp_path / "b.py").write_text(bad + "\n# touched\n",
+                                   encoding="utf-8")
+    rc = cli.main(["--changed", "--json", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["files_scanned"] == 1
+    assert {f["rule"] for f in out["findings"]} == {"GL002"}
+
+
+def test_cli_changed_picks_up_untracked_files(tmp_path, capsys):
+    _init_git_repo(tmp_path, {"a.py": "x = 1\n"})
+    bad = (FIXTURES / "gl002_bad.py").read_text(encoding="utf-8")
+    (tmp_path / "new.py").write_text(bad, encoding="utf-8")
+    rc = cli.main(["--changed", "--json", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["files_scanned"] == 1
+
+
+def test_cli_changed_outside_git_falls_back_to_full_scan(
+        tmp_path, capsys, monkeypatch):
+    monkeypatch.setattr(cli, "_git_changed_files", lambda anchor: None)
+    bad = (FIXTURES / "gl002_bad.py").read_text(encoding="utf-8")
+    (tmp_path / "b.py").write_text(bad, encoding="utf-8")
+    rc = cli.main(["--changed", str(tmp_path)])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "falls back to a full scan" in captured.err

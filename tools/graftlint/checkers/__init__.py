@@ -16,6 +16,13 @@ def all_checkers() -> List[object]:
         RegistryDriftChecker)
     from tools.graftlint.checkers.gl005_determinism import (
         DeterminismChecker)
+    from tools.graftlint.checkers.gl006_collective_divergence import (
+        CollectiveDivergenceChecker)
+    from tools.graftlint.checkers.gl007_accumulator_width import (
+        AccumulatorWidthChecker)
+    from tools.graftlint.checkers.gl008_cross_function import (
+        CrossFunctionChecker)
     return [CollectiveAxisChecker(), TracerHygieneChecker(),
             RecompilationChecker(), RegistryDriftChecker(),
-            DeterminismChecker()]
+            DeterminismChecker(), CollectiveDivergenceChecker(),
+            AccumulatorWidthChecker(), CrossFunctionChecker()]

@@ -31,7 +31,9 @@ def build_parser() -> argparse.ArgumentParser:
         description="JAX/TPU-aware static analysis for mmlspark_tpu "
                     "(GL001 collective axes, GL002 tracer hygiene, "
                     "GL003 recompilation hazards, GL004 registry "
-                    "drift, GL005 determinism)")
+                    "drift, GL005 determinism, GL006 collective "
+                    "divergence, GL007 accumulator width, GL008 "
+                    "cross-function context)")
     p.add_argument("paths", nargs="*", default=["mmlspark_tpu"],
                    help="files or directories to scan "
                         "(default: mmlspark_tpu)")
@@ -51,7 +53,53 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--repo-root", type=Path, default=None,
                    help="override repo-root discovery (pyproject.toml "
                         "anchor) for GL004's doc/registry lookups")
+    p.add_argument("--changed", action="store_true",
+                   help="scan only files modified per `git diff "
+                        "--name-only` (+ untracked); falls back to a "
+                        "full scan outside a git repo")
     return p
+
+
+def _git_changed_files(anchor: Path):
+    """Absolute paths of modified + untracked .py files, or None when
+    not in a git repo (caller falls back to a full scan)."""
+    import subprocess
+    cwd = anchor if anchor.is_dir() else anchor.parent
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"], cwd=cwd,
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if top.returncode != 0:
+        return None
+    root = Path(top.stdout.strip())
+    files = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD", "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            r = subprocess.run(cmd, cwd=root, capture_output=True,
+                               text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if r.returncode != 0:
+            continue
+        for line in r.stdout.splitlines():
+            if line.strip().endswith(".py"):
+                files.add((root / line.strip()).resolve())
+    return files
+
+
+def _restrict_to_changed(paths, changed):
+    """The subset of ``changed`` that lives under one of ``paths``."""
+    out = []
+    for c in changed:
+        for p in paths:
+            rp = p.resolve()
+            if c == rp or rp in c.parents:
+                out.append(c)
+                break
+    return out
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -65,6 +113,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"graftlint: path does not exist: {p}",
                   file=sys.stderr)
             return 2
+
+    if args.changed:
+        changed = _git_changed_files(paths[0] if paths else Path.cwd())
+        if changed is None:
+            print("graftlint: not a git repo, --changed falls back to "
+                  "a full scan", file=sys.stderr)
+        else:
+            paths = [Path(p) for p in
+                     _restrict_to_changed(paths, changed)]
+            if not paths:
+                if args.as_json:
+                    print(json.dumps({"findings": [], "suppressed": 0,
+                                      "files_scanned": 0}, indent=2))
+                else:
+                    print("graftlint: no changed python files under "
+                          "the given paths")
+                return 0
 
     project, findings = core.run_checks(paths, select=select,
                                         repo_root=args.repo_root)

@@ -185,6 +185,70 @@ def write_baseline(path: Path, findings: Sequence[Finding]) -> None:
                           encoding="utf-8")
 
 
+# --- inline suppression -----------------------------------------------------
+
+_SUPPRESS_RE = None  # compiled lazily; module stays import-light
+
+KNOWN_RULES = frozenset(
+    {"GL000"} | {f"GL{n:03d}" for n in range(1, 9)})
+
+
+def _suppress_regex():
+    global _SUPPRESS_RE
+    if _SUPPRESS_RE is None:
+        import re
+        _SUPPRESS_RE = re.compile(
+            r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+    return _SUPPRESS_RE
+
+
+def _line_suppressions(pf: ParsedFile):
+    """line number -> set of rule codes disabled on that line, plus
+    warning findings for unknown codes."""
+    out: Dict[int, Set[str]] = {}
+    warnings: List[Finding] = []
+    rx = _suppress_regex()
+    for lineno, text in enumerate(pf.lines, start=1):
+        if "graftlint" not in text:
+            continue
+        m = rx.search(text)
+        if not m:
+            continue
+        codes = {c.strip().upper() for c in m.group(1).split(",")
+                 if c.strip()}
+        for code in sorted(codes):
+            if code not in KNOWN_RULES and code != "ALL":
+                warnings.append(Finding(
+                    rule="GL000", severity="warning", path=pf.rel,
+                    line=lineno, col=text.index("#"),
+                    message=f"unknown rule code {code!r} in graftlint "
+                            f"suppression comment",
+                    hint=f"known codes are "
+                         f"{', '.join(sorted(KNOWN_RULES))} (or 'all')"))
+        out[lineno] = codes
+    return out, warnings
+
+
+def apply_inline_suppressions(project: Project,
+                              findings: List[Finding]) -> List[Finding]:
+    """Honor ``# graftlint: disable=GL00N`` end-of-line comments: a
+    finding anchored to an annotated line is dropped; unknown codes
+    produce a GL000 warning so typos don't silently disable nothing."""
+    by_rel: Dict[str, ParsedFile] = {pf.rel: pf for pf in project.files}
+    maps: Dict[str, Dict[int, Set[str]]] = {}
+    kept: List[Finding] = []
+    extra: List[Finding] = []
+    for rel, pf in by_rel.items():
+        maps[rel], warns = _line_suppressions(pf)
+        extra.extend(warns)
+    for f in findings:
+        codes = maps.get(f.path, {}).get(f.line)
+        if codes and (f.rule in codes or "ALL" in codes):
+            continue
+        kept.append(f)
+    return kept + extra
+
+
 # --- runner ----------------------------------------------------------------
 
 def run_checks(paths: Sequence[Path],
@@ -192,9 +256,10 @@ def run_checks(paths: Sequence[Path],
                repo_root: Optional[Path] = None):
     """Parse ``paths`` and run the (selected) checkers.
 
-    Returns ``(project, findings)``; findings are fingerprint-stamped
-    and sorted by (path, line, rule). Baseline filtering is the CLI's
-    job — callers see everything."""
+    Returns ``(project, findings)``; findings are fingerprint-stamped,
+    inline-suppression-filtered and sorted by (path, line, rule).
+    Baseline filtering is the CLI's job — callers see everything
+    else."""
     from tools.graftlint.checkers import all_checkers
 
     project = Project(paths, repo_root=repo_root)
@@ -204,6 +269,7 @@ def run_checks(paths: Sequence[Path],
         if wanted is not None and checker.rule not in wanted:
             continue
         findings.extend(checker.check_project(project))
+    findings = apply_inline_suppressions(project, findings)
     stamp_fingerprints(project, findings)
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return project, findings
