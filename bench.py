@@ -48,10 +48,22 @@ def _apply_platform_override():
         jax.config.update("jax_platforms", p)
 
 
-def probe_backend(attempt_timeout=90.0):
+def _probe_timeout_default():
+    from mmlspark_tpu.core.env import env_int
+    return env_int("MMLSPARK_TPU_BENCH_PROBE_TIMEOUT_S", 90, minimum=1)
+
+
+def probe_backend(attempt_timeout=None):
     """One subprocess backend-init probe (hang-safe). Returns
     (ok, detail): detail is 'platform ndevices' on success, else the
-    error tail. Shared by the bench scripts and tools/tpu_poll.py."""
+    error tail. Shared by the bench scripts and tools/tpu_poll.py.
+
+    ``attempt_timeout`` defaults to MMLSPARK_TPU_BENCH_PROBE_TIMEOUT_S:
+    the right budget depends on how the TPU is attached (a local
+    backend initializes in seconds; a tunneled one can take minutes
+    when the remote end is cold), which only the operator knows."""
+    if attempt_timeout is None:
+        attempt_timeout = _probe_timeout_default()
     try:
         out = subprocess.run(
             [sys.executable, "-c", _PROBE], capture_output=True,
@@ -63,12 +75,19 @@ def probe_backend(attempt_timeout=90.0):
         return False, f"backend init hang (> {attempt_timeout}s)"
 
 
-def wait_for_backend(attempt_timeout=90.0, backoffs=(15, 30, 60, 120, 240),
+def wait_for_backend(attempt_timeout=None, backoffs=(15, 30, 60, 120, 240),
                      metric="gbdt_fit_throughput_higgs28f_2M",
                      unit="Mrow-trees/s", allow_cpu_fallback=False):
     """Probe backend init in a subprocess with bounded retry/backoff,
     then apply the BENCH_PLATFORM override to THIS process so the main
     workload initializes the same backend the probe validated.
+
+    MMLSPARK_TPU_BENCH_PROBE_ATTEMPTS caps total attempts (default 6 =
+    first try + the five backoffs; more attempts repeat the longest
+    backoff) and MMLSPARK_TPU_BENCH_PROBE_TIMEOUT_S the per-attempt
+    budget — an overnight TPU-window queue wants hours of patience, a
+    CI smoke wants to fail in under a minute, and neither should need
+    a code edit.
 
     Returns the probed platform string. If every attempt hangs or
     errors: with ``allow_cpu_fallback`` the CPU backend is configured
@@ -76,8 +95,15 @@ def wait_for_backend(attempt_timeout=90.0, backoffs=(15, 30, 60, 120, 240),
     their output); otherwise exits EX_BACKEND_UNREACHABLE with a
     diagnostic JSON line.
     """
+    from mmlspark_tpu.core.env import env_int
+    attempts = env_int("MMLSPARK_TPU_BENCH_PROBE_ATTEMPTS", 6, minimum=1)
+    pauses = (0,) + tuple(backoffs)
+    if attempts <= len(pauses):
+        pauses = pauses[:attempts]
+    else:
+        pauses = pauses + (pauses[-1],) * (attempts - len(pauses))
     last = ""
-    for i, pause in enumerate((0,) + tuple(backoffs)):
+    for i, pause in enumerate(pauses):
         if pause:
             time.sleep(pause)
         ok, detail = probe_backend(attempt_timeout)
@@ -187,6 +213,11 @@ def main():
         "hist_formulation": resolve_histogram_formulation(255, warn=False),
         "hist_subtract": resolve_subtract("serial", 255),
         "native_hist_available": native_histogram_available(),
+        # quant/EFB/grow-policy provenance from the timed fit itself
+        # (result.hist_stats), not a re-resolution that could disagree
+        **{k: result.hist_stats.get(k)
+           for k in ("grow_policy", "hist_quant", "efb_bundles",
+                     "efb_bundled_features")},
         "graftsan_enabled": sanitizer.enabled(),
         "graftsan_disabled_overhead_ns": (
             round(san_disabled_ns, 1) if san_disabled_ns is not None

@@ -112,6 +112,26 @@ SAN_RECOMPILE_BUDGET = register(
     "MMLSPARK_TPU_SAN_RECOMPILE_BUDGET", "int", 0,
     "with graftsan enabled: max compilations per process before "
     "RecompileBudgetExceeded (0 = count only, never raise)")
+HIST_QUANT = register(
+    "MMLSPARK_TPU_HIST_QUANT", "str", "off",
+    "gradient/hessian quantization for histogram construction: "
+    "off|q16|q8; shared per-round pow2 scale, int32 accumulation with "
+    "periodic rescale (arXiv:2011.02022)")
+EFB = register(
+    "MMLSPARK_TPU_EFB", "str", "auto",
+    "exclusive feature bundling for histogram construction: auto|off|on"
+    " — auto gates the planner on a sampled sparsity estimate, on "
+    "forces planning even for dense-looking data")
+GROW_POLICY = register(
+    "MMLSPARK_TPU_GROW_POLICY", "str", "depthwise",
+    "tree growth policy: depthwise|leafwise; leafwise drives splits by "
+    "a max-gain priority queue capped by num_leaves")
+BENCH_PROBE_TIMEOUT_S = register(
+    "MMLSPARK_TPU_BENCH_PROBE_TIMEOUT_S", "int", 90,
+    "bench.py: seconds per TPU backend probe attempt")
+BENCH_PROBE_ATTEMPTS = register(
+    "MMLSPARK_TPU_BENCH_PROBE_ATTEMPTS", "int", 6,
+    "bench.py: max TPU backend probe attempts before falling back")
 
 
 _WARNED: Set[str] = set()

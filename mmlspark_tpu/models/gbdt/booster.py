@@ -90,6 +90,15 @@ class BoosterArrays:
         return self.split_feature.shape[1]
 
     @property
+    def num_leaves_per_tree(self) -> np.ndarray:
+        """(T,) actual leaves per tree. In the full heap layout every
+        split turns one leaf into two, so leaves = splits + 1 — policy-
+        agnostic: depth-wise trees report their within-level budget
+        usage, leaf-wise trees (MMLSPARK_TPU_GROW_POLICY=leafwise)
+        their best-first allocation against the ``num_leaves`` cap."""
+        return np.asarray((self.split_feature >= 0).sum(axis=1) + 1)
+
+    @property
     def supports_binned(self) -> bool:
         """Single source of truth for binned-scoring eligibility
         (``predict_binned_fn``'s raise-paths and the model-level

@@ -193,3 +193,25 @@ def pallas_level_histogram(binned, grad, hess, live, local, width, f, b,
             _pallas_level_histogram, width=w, f=nf, b=nb, block_rows=br,
             interpret=it))
     return _JIT_CACHE[key](binned, grad, hess, live, local)
+
+
+def pallas_level_histogram_quant(binned, grad_q, hess_q, live, local,
+                                 width, f, b, gscale_inv, hscale_inv,
+                                 block_rows: int = 512, interpret=None):
+    """Quantized-gradient entry point (MMLSPARK_TPU_HIST_QUANT): int16/
+    int8 grad/hess with shared per-round pow2 scales. int * pow2 is
+    exact in float32, so dequantizing up front feeds the f32 matmul
+    kernel the SAME values the int32-accumulating native kernel sums —
+    the three backends agree to f32 accumulation order, which is the
+    same parity contract as the unquantized path. (A native-int MXU
+    accumulation would need an int8 operand layout and per-block
+    rescale; not worth it while the kernel is bandwidth-bound on the
+    binned matrix, see the cost note in the module docstring.)"""
+    import jax.numpy as jnp
+
+    grad = grad_q.astype(jnp.float32) * gscale_inv
+    hess = hess_q.astype(jnp.float32) * hscale_inv
+    return pallas_level_histogram(binned, grad, hess,
+                                  live.astype(jnp.float32), local,
+                                  width, f, b, block_rows=block_rows,
+                                  interpret=interpret)

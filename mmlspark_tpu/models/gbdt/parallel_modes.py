@@ -114,8 +114,14 @@ def _histogram(binned, grad, hess, live, local, width, f, b):
     # With MMLSPARK_TPU_PALLAS_HIST=1 this selects the pallas kernel
     # per-shard (local rows only; the psum on the returned histogram is
     # unchanged) — the multi-chip path for the flagship op.
-    from mmlspark_tpu.models.gbdt.trainer import _level_histogram
+    from mmlspark_tpu.models.gbdt.trainer import (_level_histogram,
+                                                  resolve_hist_quant)
 
+    # quantized accumulation is a serial-fit path (the psum would sum
+    # per-shard dequantized f32 anyway, erasing the int32 win); resolve
+    # here only so a sharded fit with HIST_QUANT set warns once that
+    # the knob is being ignored rather than silently mislabeling an A/B
+    resolve_hist_quant(in_shard_map=True)
     return _level_histogram(binned, grad, hess, live, local, width, f, b,
                             in_shard_map=True)
 

@@ -332,6 +332,97 @@ def resolve_histogram_formulation(b: int, in_shard_map: bool = False,
     return "separate" if jax.default_backend() == "tpu" else "fused"
 
 
+_WARNED_BAD_QUANT = False
+_WARNED_QUANT_SHARD = False
+
+_VALID_QUANT = ("off", "q16", "q8")
+
+
+def resolve_hist_quant(in_shard_map: bool = False,
+                       warn: bool = True) -> str:
+    """Gradient/hessian histogram-quantization policy
+    (MMLSPARK_TPU_HIST_QUANT, default off): per-round grad/hess
+    quantized to int16 (q16) or int8 (q8) with a shared power-of-two
+    scale, accumulated in int32 with periodic rescale into wide
+    accumulators, dequantized only at split-gain evaluation
+    (arXiv:2011.02022's quantized training scheme). Follows the same
+    bad-value contract as ``resolve_histogram_formulation``: a mistyped
+    value warns once and runs unquantized rather than mislabeling a
+    measurement. Single-program only — the shard_map builders keep f32
+    histograms (the native quant kernel is a host callback and the
+    chunked-scan XLA mirror's carry is not shard_map-safe), downgrading
+    with a warning so A/B labels stay honest."""
+    global _WARNED_BAD_QUANT, _WARNED_QUANT_SHARD
+    raw = (env_str("MMLSPARK_TPU_HIST_QUANT", "") or "").strip().lower()
+    if not raw:
+        return "off"
+    if raw not in _VALID_QUANT:
+        if warn and not _WARNED_BAD_QUANT:
+            _WARNED_BAD_QUANT = True
+            import warnings
+            warnings.warn(
+                f"MMLSPARK_TPU_HIST_QUANT={raw!r} is not one of "
+                "off|q16|q8; histograms run unquantized", stacklevel=2)
+        return "off"
+    if raw != "off" and in_shard_map:
+        if warn and not _WARNED_QUANT_SHARD:
+            _WARNED_QUANT_SHARD = True
+            import warnings
+            warnings.warn(
+                "MMLSPARK_TPU_HIST_QUANT is single-program only; the "
+                "shard_map tree learners build f32 histograms — label "
+                "A/B measurements accordingly", stacklevel=2)
+        return "off"
+    return raw
+
+
+_WARNED_BAD_GROW = False
+_WARNED_LEAFWISE_DOWNGRADE = False
+
+_VALID_GROW = ("depthwise", "leafwise")
+
+
+def resolve_grow_policy(warn: bool = True) -> str:
+    """Tree growth policy (MMLSPARK_TPU_GROW_POLICY, default
+    depthwise): ``leafwise`` grows each tree by a max-gain priority
+    queue capped by ``num_leaves`` (LightGBM's native policy;
+    arXiv:1706.08359 §2) over the same level-histogram kernels with
+    sibling subtraction; ``depthwise`` is the compiled full-level
+    builder with the within-level leaf budget. Bad values warn once
+    and run depthwise (core.env contract)."""
+    global _WARNED_BAD_GROW
+    raw = (env_str("MMLSPARK_TPU_GROW_POLICY", "") or "").strip().lower()
+    if not raw:
+        return "depthwise"
+    if raw not in _VALID_GROW:
+        if warn and not _WARNED_BAD_GROW:
+            _WARNED_BAD_GROW = True
+            import warnings
+            warnings.warn(
+                f"MMLSPARK_TPU_GROW_POLICY={raw!r} is not one of "
+                "depthwise|leafwise; growing depthwise", stacklevel=2)
+        return "depthwise"
+    return raw
+
+
+def _leafwise_supported(cfg: "TrainConfig", mesh) -> Optional[str]:
+    """None when leaf-wise growth can honor this config, else the
+    human-readable reason for the depthwise fallback."""
+    if mesh is not None:
+        return "a device mesh is attached (leafwise is single-program)"
+    if cfg.tree_learner in ("voting", "feature"):
+        return f"tree_learner={cfg.tree_learner!r}"
+    if cfg.categorical_features:
+        return "categorical_features"
+    if any(cfg.monotone_constraints or ()):
+        return "monotone_constraints"
+    if cfg.extra_trees:
+        return "extra_trees"
+    if cfg.feature_fraction_by_node < 1.0:
+        return "feature_fraction_by_node"
+    return None
+
+
 _WARNED_ASYNC_CALLBACK = False
 
 
@@ -468,6 +559,252 @@ def _native_level_histogram(binned, grad, hess, live, local, width, f, b):
         vma=operand_vma(binned, grad, hess, live, local))
     return jax.pure_callback(_cb, out_type, binned, grad, hess, live,
                              local.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Host-binned registry: the binned matrix is host-resident numpy for the
+# whole fit, so the native-histogram callback can read it directly
+# instead of receiving it as a traced operand. At bench shape the
+# operand marshal (2M x 28 uint8 per level) dominated callback cost;
+# the registered-matrix path passes a scalar int32 token instead. The
+# token is a TRACED operand (not a jit constant): successive fits reuse
+# one compiled step with different tokens, so the compile caches (and
+# the sanitizer's recompile budget) see one program, not one per fit.
+# ---------------------------------------------------------------------------
+
+_HOST_BINNED_REG: Dict[int, np.ndarray] = {}
+_HOST_BINNED_NEXT = [1]
+
+
+def _register_host_binned(arr: np.ndarray) -> int:
+    """Register a host binned matrix for callback-side lookup; returns
+    the token to pass as the builder's ``hist_token``. The caller owns
+    the lifetime: release after every dispatched step has completed
+    (``train`` releases after the final ``block_until_ready``)."""
+    tok = _HOST_BINNED_NEXT[0]
+    _HOST_BINNED_NEXT[0] += 1
+    _HOST_BINNED_REG[tok] = arr
+    return tok
+
+
+def _release_host_binned(tok: int) -> None:
+    _HOST_BINNED_REG.pop(tok, None)
+
+
+def _host_binned_lookup(tok: int) -> np.ndarray:
+    try:
+        return _HOST_BINNED_REG[tok]
+    except KeyError:
+        raise RuntimeError(
+            f"host-binned token {tok} is not registered — a histogram "
+            "callback ran after its train() call released the training "
+            "matrix (or a compiled step was invoked outside train)"
+        ) from None
+
+
+_NATIVE_HIST_PRIM_V2 = None
+
+
+def _native_hist_primitive_v2():
+    """Second-generation raw-callback primitive (jax 0.4.x; see
+    ``_native_hist_primitive`` for why pure_callback is unusable
+    there). Extends v1 with two statics the flagship CPU path needs:
+
+      - ``quant``: "off" | "q16" | "q8" — dispatch to the quantized
+        int32-accumulation kernels (mmls_level_hist_q16/_q8), taking
+        int grad/hess, a uint8 live gate and the two f32 dequant
+        scales as extra scalar operands;
+      - ``has_token``: the binned matrix is looked up host-side from
+        ``_HOST_BINNED_REG`` by a scalar token operand instead of
+        being marshalled through the callback per level.
+
+    v1 stays as-is: it serves the operand-passing formulation the
+    shard_map builders and direct ``make_build_tree`` callers use."""
+    global _NATIVE_HIST_PRIM_V2
+    if _NATIVE_HIST_PRIM_V2 is not None:
+        return _NATIVE_HIST_PRIM_V2
+    import jax.numpy as jnp
+    from jax._src import core as jcore
+    from jax._src.interpreters import mlir as jmlir
+
+    prim = jcore.Primitive("mmlspark_native_level_hist_v2")
+
+    def _run(first, g, h, lv, lo, *scales, width, n_bins, num_features,
+             quant, has_token):
+        fault_point("native.callback")
+        from mmlspark_tpu.native import bindings
+        bn = (_host_binned_lookup(int(np.asarray(first))) if has_token
+              else np.asarray(first))
+        if quant == "off":
+            return bindings.level_histogram(bn, g, h, lv, lo, width,
+                                            n_bins)
+        gsi, hsi = scales
+        return bindings.level_histogram_quant(
+            bn, g, h, lv, lo, width, n_bins,
+            float(np.asarray(gsi)), float(np.asarray(hsi)))
+
+    def _abstract(first, g, h, lv, lo, *scales, width, n_bins,
+                  num_features, quant, has_token):
+        return jcore.ShapedArray((width, num_features, n_bins, 3),
+                                 np.float32)
+
+    def _impl(*args, width, n_bins, num_features, quant, has_token):
+        host = [np.asarray(a) for a in args]
+        return jnp.asarray(_run(*host, width=width, n_bins=n_bins,
+                                num_features=num_features, quant=quant,
+                                has_token=has_token))
+
+    def _lowering(ctx, *args, width, n_bins, num_features, quant,
+                  has_token):
+        def _cb(*host_args):
+            return (_run(*host_args, width=width, n_bins=n_bins,
+                         num_features=num_features, quant=quant,
+                         has_token=has_token),)
+        result, _, _ = jmlir.emit_python_callback(
+            ctx, _cb, None, list(args), ctx.avals_in, ctx.avals_out,
+            has_side_effect=False)
+        return result
+
+    prim.def_abstract_eval(_abstract)
+    prim.def_impl(_impl)
+    jmlir.register_lowering(prim, _lowering)
+    _NATIVE_HIST_PRIM_V2 = prim
+    return prim
+
+
+def _native_level_histogram_v2(binned, grad, hess, live, local, width,
+                               f, b, gscale_inv=None, hscale_inv=None,
+                               token=None, quant="off"):
+    """Native level histogram through the v2 callback: optional
+    registered-matrix token (``binned`` is ignored when set) and
+    optional quantized kernels. Output contract matches
+    ``_native_level_histogram``: (width, f, b, 3) f32."""
+    import jax
+    import jax.numpy as jnp
+
+    ops = [token if token is not None else binned,
+           grad, hess, live, local.astype(jnp.int32)]
+    if quant != "off":
+        ops += [gscale_inv, hscale_inv]
+
+    if _raw_callback_needed():
+        return _native_hist_primitive_v2().bind(
+            *ops, width=width, n_bins=b, num_features=f, quant=quant,
+            has_token=token is not None)
+
+    _warn_async_callback_hazard()
+
+    def _cb(*args, _w=width, _b=b, _q=quant, _tok=token is not None):
+        fault_point("native.callback")
+        from mmlspark_tpu.native import bindings
+        host = [np.asarray(a) for a in args]
+        bn = _host_binned_lookup(int(host[0])) if _tok else host[0]
+        if _q == "off":
+            return bindings.level_histogram(bn, *host[1:5], _w, _b)
+        return bindings.level_histogram_quant(
+            bn, *host[1:5], _w, _b, float(host[5]), float(host[6]))
+
+    from mmlspark_tpu.core.jax_compat import (operand_vma,
+                                              shape_dtype_struct)
+    out_type = shape_dtype_struct((width, f, b, 3), jnp.float32,
+                                  vma=operand_vma(*ops))
+    return jax.pure_callback(_cb, out_type, *ops)
+
+
+def _pow2_scale(amax, qmax):
+    """Power-of-two quantization scale pair (scale, scale_inv) mapping
+    |x| <= amax into [-qmax, qmax]. Restricting to powers of two makes
+    ``int_value * scale_inv`` an exponent shift — exact in f32 — so
+    every backend dequantizing the same int32 totals produces identical
+    floats, and the native kernel's int64-exact merge stays bit-stable
+    across worker counts."""
+    import jax.numpy as jnp
+    amax = jnp.maximum(amax.astype(jnp.float32), jnp.float32(1e-30))
+    e = jnp.clip(jnp.floor(jnp.log2(jnp.float32(qmax) / amax)),
+                 -126.0, 126.0)
+    return jnp.exp2(e).astype(jnp.float32), \
+        jnp.exp2(-e).astype(jnp.float32)
+
+
+def _level_histogram_quant(binned, grad_q, hess_q, live, local, width,
+                           f, b, gscale_inv, hscale_inv,
+                           formulation: str, token=None):
+    """Quantized-gradient level histogram: (N,) int16/int8 grad/hess ->
+    (width, F, B, 3) f32 dequantized sums. ``live`` keeps the f32 0/1
+    row-mask contract of ``_level_histogram`` (converted to the uint8
+    gate the native kernel takes). Three formulations mirror the f32
+    dispatch:
+
+      - native: mmls_level_hist_q16/_q8 (int32 SIMD tiles, periodic
+        flush into per-worker int64 accumulators, single f32 rounding
+        at merge — bit-identical to an int64 reference for any worker
+        count);
+      - pallas: exact dequantize (int * pow2 scale) feeding the
+        existing Mosaic kernel — int histogramming inside VMEM is a
+        measured-on-TPU follow-up, the mirror exists for parity;
+      - XLA: lax.scan over flush-sized row chunks, int32 segment_sum
+        per chunk folded into an f32 accumulator — the periodic-rescale
+        idiom (graftlint GL007 enforces the int32 widening).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if formulation == "native":
+        return _native_level_histogram_v2(
+            binned, grad_q, hess_q, live.astype(jnp.uint8), local,
+            width, f, b, gscale_inv=gscale_inv, hscale_inv=hscale_inv,
+            token=token,
+            quant="q8" if grad_q.dtype == jnp.int8 else "q16")
+
+    if formulation == "pallas":
+        from mmlspark_tpu.models.gbdt.hist_pallas import (
+            pallas_level_histogram_quant,
+        )
+        return pallas_level_histogram_quant(
+            binned, grad_q, hess_q, live, local, width, f, b,
+            gscale_inv, hscale_inv)
+
+    # XLA mirror, one implementation for the segment_sum formulations:
+    # int32 products are safe within a chunk (q16: 2^16 rows * 32001 <
+    # 2^31; q8: 2^24 rows * 121 < 2^31), and each chunk's exact int32
+    # partial is rescaled into the f32 accumulator before the next
+    # chunk can overflow.
+    n = binned.shape[0]
+    if n == 0:
+        return jnp.zeros((width, f, b, 3), jnp.float32)
+    flush = (1 << 24) if grad_q.dtype == jnp.int8 else (1 << 16)
+    chunk = min(n, flush)
+    pad = (-n) % chunk
+    gate = (live > 0).astype(jnp.int32)
+    g32 = grad_q.astype(jnp.int32) * gate
+    h32 = hess_q.astype(jnp.int32) * gate
+    bc = jnp.pad(binned, ((0, pad), (0, 0))) if pad else binned
+    lc = jnp.pad(local, (0, pad)) if pad else local
+    gc = jnp.pad(g32, (0, pad)) if pad else g32
+    hc = jnp.pad(h32, (0, pad)) if pad else h32
+    # padded rows carry a zero gate, so they add nothing to bin 0
+    cc = jnp.pad(gate, (0, pad)) if pad else gate
+
+    def chunk_body(acc, xs):
+        cb, cl, cg, ch, cn = xs
+        base = (cl[:, None] * f + jnp.arange(f)[None, :]) * b
+        idx = (base + cb.astype(jnp.int32)).reshape(-1)
+        data = jnp.stack([
+            jnp.broadcast_to(cg[:, None], (chunk, f)).reshape(-1),
+            jnp.broadcast_to(ch[:, None], (chunk, f)).reshape(-1),
+            jnp.broadcast_to(cn[:, None], (chunk, f)).reshape(-1),
+        ], axis=-1)
+        part = jax.ops.segment_sum(data, idx,
+                                   num_segments=width * f * b)
+        return acc + part.astype(jnp.float32), None
+
+    xs = (bc.reshape(-1, chunk, f), lc.reshape(-1, chunk),
+          gc.reshape(-1, chunk), hc.reshape(-1, chunk),
+          cc.reshape(-1, chunk))
+    acc, _ = jax.lax.scan(
+        chunk_body, jnp.zeros((width * f * b, 3), jnp.float32), xs)
+    scales = jnp.stack([gscale_inv, hscale_inv, jnp.float32(1.0)])
+    return (acc * scales[None, :]).reshape(width, f, b, 3)
 
 
 def _level_histogram(binned, grad, hess, live, local, width, f, b,
@@ -623,7 +960,7 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
 
 def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
                     subtract: bool = False, allow_pallas: bool = True,
-                    allow_native: bool = True):
+                    allow_native: bool = True, efb_plan=None):
     """Compile-once tree builder: (binned, grad, hess, valid, feat_mask,
     remaining_leaves) -> (split_feature, threshold_bin, node_value, count,
     decision_type, bin_go_left).
@@ -678,6 +1015,22 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
         total_bins, in_shard_map=False, allow_pallas=allow_pallas,
         allow_native=allow_native, warn=False)
     masked_subtract = subtract and hist_formulation == "native"
+    # quantization and EFB are serial single-program paths; the GSPMD /
+    # shard_map builders keep f32 full-feature histograms (allow_native
+    # is the single-program proxy the native default shares)
+    hist_quant = resolve_hist_quant(warn=False) if allow_native else "off"
+    use_efb = efb_plan is not None
+    f_hist = efb_plan.n_cols if use_efb else num_features
+    if use_efb:
+        # static unbundling index maps (ops/efb.py): bundled-histogram
+        # slots scatter back to (original feature, original bin), then
+        # every bundled member's default bin is reconstructed as the
+        # node total minus its present bins (each live row contributes
+        # exactly once per bundled column)
+        ub_sc_col, ub_sc_bin, ub_sc_feat, ub_sc_obin = \
+            efb_plan.scatter_arrays()
+        ub_md_feat, ub_md_bin = efb_plan.member_default_arrays()
+        ub_pt_col, ub_pt_feat = efb_plan.passthrough_arrays()
     mono_np = np.zeros(num_features, dtype=np.float32)
     if cfg.monotone_constraints:
         if len(cfg.monotone_constraints) > num_features:
@@ -696,30 +1049,102 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
         return value, score
 
     def build_tree(binned, grad, hess, valid, feat_mask, remaining_leaves,
-                   key=None):
+                   key=None, hist_token=None, binned_hist=None):
         """binned (N,F) int32; grad/hess (N,) f32; valid (N,) f32 row mask
         (bagging/GOSS already folded into grad/hess scaling + this mask);
         feat_mask (F,) f32; remaining_leaves traced int; key seeds the
-        extra_trees random thresholds (required when extra_trees)."""
+        extra_trees random thresholds (required when extra_trees).
+
+        ``hist_token``: scalar int32 token of a host-registered binned
+        matrix (native formulation only) — histogram callbacks read the
+        registered matrix instead of marshalling ``binned`` per level.
+        ``binned_hist``: the EFB-bundled matrix for non-native
+        formulations (when a plan is active and no token is given).
+        Both default to None so direct callers keep the old signature;
+        routing and split recording always use the original ``binned``."""
         if (cfg.extra_trees or cfg.feature_fraction_by_node < 1.0) \
                 and key is None:
             raise ValueError("extra_trees / feature_fraction_by_node "
                              "need an rng key")
+        if use_efb and binned_hist is None and hist_token is None:
+            raise ValueError("an EFB-planned builder needs binned_hist "
+                             "(XLA formulations) or hist_token (native)")
         n = binned.shape[0]
         f = num_features
         b = total_bins
+        # matrix histogram calls index: the bundled one under EFB (the
+        # token path never reads it — callbacks hold the bundled host
+        # matrix — so the original stands in as a placeholder operand)
+        hist_mat = binned_hist if (use_efb and binned_hist is not None) \
+            else binned
+        if hist_quant != "off":
+            # per-round shared pow2 scale; invalid rows quantize to 0
+            # (valid is folded in) so the kernels' live gate and the
+            # quantized values agree
+            qdt = jnp.int8 if hist_quant == "q8" else jnp.int16
+            qmax = 120.0 if hist_quant == "q8" else 32000.0
+            gscale, gscale_inv = _pow2_scale(
+                jnp.max(jnp.abs(grad) * valid), qmax)
+            hscale, hscale_inv = _pow2_scale(
+                jnp.max(jnp.abs(hess) * valid), qmax)
+            grad_h = jnp.rint(grad * valid * gscale).astype(qdt)
+            hess_h = jnp.rint(hess * valid * hscale).astype(qdt)
+        else:
+            grad_h, hess_h = grad, hess
+            gscale_inv = hscale_inv = None
+
+        def _unbundle_hist(hb, width):
+            # (width, f_hist, B, 3) bundled -> (width, F, B, 3) original
+            hist = jnp.zeros((width, f, b, 3), hb.dtype)
+            if len(ub_pt_col):
+                hist = hist.at[:, ub_pt_feat].set(hb[:, ub_pt_col])
+            if len(ub_sc_col):
+                hist = hist.at[:, ub_sc_feat, ub_sc_obin].set(
+                    hb[:, ub_sc_col, ub_sc_bin])
+            if len(ub_md_feat):
+                # node totals from any one bundled column (every live
+                # row lands in exactly one of its bins); a member's
+                # default-bin stats are total minus its present bins —
+                # exact for counts, f32-rounding for grad/hess
+                total = hb[:, 0].sum(axis=1)             # (width, 3)
+                present = hist[:, ub_md_feat].sum(axis=2)
+                hist = hist.at[:, ub_md_feat, ub_md_bin].set(
+                    total[:, None, :] - present)
+            return hist
+
+        def _hist(bn_h, g_, h_, lv, lo, width):
+            if hist_quant != "off":
+                hist = _level_histogram_quant(
+                    bn_h, g_, h_, lv, lo, width, f_hist, b,
+                    gscale_inv, hscale_inv,
+                    formulation=hist_formulation,
+                    token=(hist_token
+                           if hist_formulation == "native" else None))
+            elif hist_token is not None and hist_formulation == "native":
+                hist = _native_level_histogram_v2(
+                    bn_h, g_, h_, lv, lo, width, f_hist, b,
+                    token=hist_token)
+            else:
+                hist = _level_histogram(
+                    bn_h, g_, h_, lv, lo, width, f_hist, b,
+                    allow_pallas=allow_pallas,
+                    allow_native=allow_native,
+                    formulation=hist_formulation)
+            return _unbundle_hist(hist, width) if use_efb else hist
+
         if subtract:
             prev_hist = prev_split = prev_ss = None
             if not masked_subtract:
                 # +1 dummy slot: sized-nonzero fill target for the
-                # smaller-child compaction gather
+                # smaller-child compaction gather (over the histogram
+                # matrix and the possibly-quantized stats)
                 n_half = n // 2 + 1
                 binned_pad = jnp.concatenate(
-                    [binned, jnp.zeros((1, f), binned.dtype)])
+                    [hist_mat, jnp.zeros((1, f_hist), hist_mat.dtype)])
                 grad_pad = jnp.concatenate(
-                    [grad, jnp.zeros(1, grad.dtype)])
+                    [grad_h, jnp.zeros(1, grad_h.dtype)])
                 hess_pad = jnp.concatenate(
-                    [hess, jnp.zeros(1, hess.dtype)])
+                    [hess_h, jnp.zeros(1, hess_h.dtype)])
 
         node = jnp.zeros(n, dtype=jnp.int32)       # slot in full layout
         done = jnp.zeros(n, dtype=jnp.bool_)        # settled in a leaf
@@ -771,24 +1196,18 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
                     # native kernel: masked rows are skipped before
                     # their bin row is read, so zeroing ``live`` on the
                     # larger sibling IS the compaction — no gather
-                    hist_small = _level_histogram(
-                        binned, grad, hess,
-                        live * sel.astype(live.dtype), local, width, f,
-                        b, allow_pallas=allow_pallas,
-                        allow_native=allow_native,
-                        formulation=hist_formulation)
+                    hist_small = _hist(
+                        hist_mat, grad_h, hess_h,
+                        live * sel.astype(live.dtype), local, width)
                 else:
                     idx = jnp.nonzero(sel, size=n_half, fill_value=n)[0]
                     live_pad = jnp.concatenate(
                         [live, jnp.zeros(1, live.dtype)])
                     local_pad = jnp.concatenate(
                         [local, jnp.zeros(1, local.dtype)])
-                    hist_small = _level_histogram(
+                    hist_small = _hist(
                         binned_pad[idx], grad_pad[idx], hess_pad[idx],
-                        live_pad[idx], local_pad[idx], width, f, b,
-                        allow_pallas=allow_pallas,
-                        allow_native=allow_native,
-                        formulation=hist_formulation)
+                        live_pad[idx], local_pad[idx], width)
                 kids = jnp.arange(width)
                 par_idx = kids // 2
                 is_small = (kids % 2) == prev_ss[par_idx]
@@ -803,11 +1222,8 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
                 hist = hist.at[..., 1].max(0.0)
                 hist = hist.at[..., 2].max(0.0)
             else:
-                hist = _level_histogram(binned, grad, hess, live, local,
-                                        width, f, b,
-                                        allow_pallas=allow_pallas,
-                                        allow_native=allow_native,
-                                        formulation=hist_formulation)
+                hist = _hist(hist_mat, grad_h, hess_h, live, local,
+                             width)
             if subtract:
                 prev_hist = hist
 
@@ -1121,7 +1537,7 @@ def _with_bin_mask(fn, total_bins):
 
 
 def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
-                 mesh) -> Callable:
+                 mesh, efb_plan=None) -> Callable:
     import jax
 
     cfg = _loop_only_normalized(cfg)
@@ -1150,7 +1566,8 @@ def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
             fn = make_build_tree(num_f, total_bins, cfg,
                                  subtract=subtract,
                                  allow_pallas=mesh is None,
-                                 allow_native=mesh is None)
+                                 allow_native=mesh is None,
+                                 efb_plan=efb_plan)
         return jax.jit(fn)
 
     if mode in ("voting", "feature") and cfg.categorical_features:
@@ -1173,11 +1590,14 @@ def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
     )
     subtract = resolve_subtract(mode, total_bins, mesh)
     # the histogram backend is chosen at trace time, so it must key the
-    # compiled-builder cache or flipping env flags is silently ignored
+    # compiled-builder cache or flipping env flags is silently ignored;
+    # an EFB plan bakes static index maps into the trace, so its
+    # fingerprint keys the cache the same way
     return _cache_put(
         _BUILDER_CACHE,
         (num_f, total_bins, cfg, mode, mesh, pallas_histogram_enabled(),
-         subtract, _hist_env_key()),
+         subtract, _hist_env_key(),
+         efb_plan.cache_key if efb_plan is not None else None),
         build)
 
 
@@ -1223,6 +1643,7 @@ def _hist_env_key() -> tuple:
             env_flag("MMLSPARK_TPU_ONEHOT_BF16"),
             env_str("MMLSPARK_TPU_HIST_SUB", "").strip(),
             env_str("MMLSPARK_TPU_NATIVE_HIST", "").strip(),
+            env_str("MMLSPARK_TPU_HIST_QUANT", "").strip(),
             native_histogram_available(),
             sync_state)
 
@@ -1253,7 +1674,7 @@ def _resolve_metrics(cfg: TrainConfig):
 # ---------------------------------------------------------------------------
 
 def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
-                  n_valid: int, mode: str, mesh):
+                  n_valid: int, mode: str, mesh, efb_plan=None):
     """One jitted function running ONE fused boosting iteration on device:
     gradients → tree build → raw/valid-raw updates → metric vector.
 
@@ -1273,7 +1694,8 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
     import jax.numpy as jnp
 
     depth = cfg.effective_depth
-    build_tree = _get_builder(num_f, total_bins, cfg, mode, mesh)
+    build_tree = _get_builder(num_f, total_bins, cfg, mode, mesh,
+                              efb_plan=efb_plan)
     predict_tree = _make_predict_tree(depth)
     objective_fn = obj_mod.get_objective(cfg.objective)
     obj_kwargs = _objective_kwargs(cfg)
@@ -1361,6 +1783,11 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
         # ----- one tree per class, raw updates ----------------------
         sfs, tbs, nvs, cnts, dts, bgls = [], [], [], [], [], []
         new_vraws = list(vraws)
+        tkw = {}
+        if data.get("hist_token") is not None:
+            tkw["hist_token"] = data["hist_token"]
+        if data.get("binned_hist") is not None:
+            tkw["binned_hist"] = data["binned_hist"]
         for cls in range(k):
             gc = g if k == 1 else g[:, cls]
             hc = h if k == 1 else h[:, cls]
@@ -1371,12 +1798,12 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
                 sf, tb, nv, cnt, dt, bgl = build_tree(
                     binned, gc.astype(jnp.float32), hc.astype(jnp.float32),
                     sample_mask.astype(jnp.float32), feat_mask,
-                    jnp.int32(nl), key=kt)
+                    jnp.int32(nl), key=kt, **tkw)
             else:
                 sf, tb, nv, cnt, dt, bgl = build_tree(
                     binned, gc.astype(jnp.float32), hc.astype(jnp.float32),
                     sample_mask.astype(jnp.float32), feat_mask,
-                    jnp.int32(nl))
+                    jnp.int32(nl), **tkw)
             nv = nv * shrink
             sfs.append(sf); tbs.append(tb); nvs.append(nv); cnts.append(cnt)
             dts.append(dt); bgls.append(bgl)
@@ -1415,7 +1842,8 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
     return jax.jit(step)
 
 
-def _get_step_fn(num_f, total_bins, cfg, k, n_valid, mode, mesh):
+def _get_step_fn(num_f, total_bins, cfg, k, n_valid, mode, mesh,
+                 efb_plan=None):
     from mmlspark_tpu.models.gbdt.hist_pallas import (
         pallas_histogram_enabled,
     )
@@ -1423,10 +1851,12 @@ def _get_step_fn(num_f, total_bins, cfg, k, n_valid, mode, mesh):
     cfg = _loop_only_normalized(cfg)
     key = (num_f, total_bins, cfg, k, n_valid, mode, mesh,
            pallas_histogram_enabled(), env_flag("MMLSPARK_TPU_HIST_SUB"),
-           _hist_env_key())
+           _hist_env_key(),
+           efb_plan.cache_key if efb_plan is not None else None)
     return _cache_put(_CHUNK_CACHE, key,
                       lambda: _make_step_fn(num_f, total_bins, cfg, k,
-                                            n_valid, mode, mesh))
+                                            n_valid, mode, mesh,
+                                            efb_plan=efb_plan))
 
 
 def aot_lower_step(cfg: TrainConfig, n: int, num_f: int,
@@ -1507,6 +1937,10 @@ class TrainResult:
     booster: BoosterArrays
     evals: List[Dict[str, float]] = field(default_factory=list)
     best_iteration: int = -1
+    # histogram-path provenance for this fit (bench.py copies it into
+    # the artifact so a throughput swing is attributable without
+    # rerunning): resolved grow policy, quant mode, EFB bundle counts
+    hist_stats: Dict[str, object] = field(default_factory=dict)
 
 
 def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
@@ -1644,6 +2078,71 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
         weights_d = None if weights is None else dev_put(
             np.asarray(weights, dtype=np.float32))
         row_valid_d = None if row_valid is None else dev_put(row_valid)
+
+        # ---- histogram-construction acceleration (serial
+        # single-program fits only) --------------------------------------
+        # grow policy: leaf-wise routes through the eager host loop
+        # (its frontier is dynamically shaped); unsupported configs
+        # fall back to depthwise with one warning so results stay
+        # honest rather than silently ignoring constraints
+        grow_policy = resolve_grow_policy()
+        if grow_policy == "leafwise":
+            reason = _leafwise_supported(cfg, mesh)
+            if reason is not None:
+                global _WARNED_LEAFWISE_DOWNGRADE
+                if not _WARNED_LEAFWISE_DOWNGRADE:
+                    _WARNED_LEAFWISE_DOWNGRADE = True
+                    import warnings
+                    warnings.warn(
+                        "MMLSPARK_TPU_GROW_POLICY=leafwise does not "
+                        f"support {reason}; growing depthwise — label "
+                        "A/B measurements accordingly", stacklevel=2)
+                grow_policy = "depthwise"
+        # EFB plan + host-binned token: the compiled builders take the
+        # bundled matrix (or a host-registry token) as call-time data,
+        # so everything here is per-fit state released in the finally
+        # below. Leaf-wise histograms on the host loop's own matrix and
+        # skips both.
+        efb_plan = None
+        hist_token_d = None
+        binned_hist_d = None
+        host_tokens: List[int] = []
+        hist_stats: Dict[str, object] = {
+            "grow_policy": grow_policy, "hist_quant": "off",
+            "efb_bundles": 0, "efb_bundled_features": 0}
+        if (mesh is None and _resolve_mode(cfg, mesh) == "serial"
+                and grow_policy == "depthwise"):
+            serial_formulation = resolve_histogram_formulation(
+                total_bins, in_shard_map=False, allow_pallas=True,
+                allow_native=True, warn=False)
+            if not cfg.categorical_features:
+                # categorical splits index per-feature bin HISTOGRAM
+                # positions during the sorted scan; bundling those
+                # columns would change category identity — skip
+                from mmlspark_tpu.ops import efb as efb_mod
+                efb_plan = efb_mod.plan_bundles(
+                    np.asarray(binned), total_bins,
+                    mode=efb_mod.resolve_efb())
+            hist_host = None
+            if efb_plan is not None:
+                from mmlspark_tpu.ops import efb as efb_mod
+                hist_host = efb_mod.apply_plan(np.asarray(binned),
+                                               efb_plan)
+            if serial_formulation == "native":
+                mat = (hist_host if hist_host is not None
+                       else np.asarray(binned))
+                tok = _register_host_binned(
+                    np.ascontiguousarray(mat, dtype=ing_dtype))
+                host_tokens.append(tok)
+                hist_token_d = jnp.asarray(tok, jnp.int32)
+            elif hist_host is not None:
+                binned_hist_d = chunked_device_put(hist_host, None,
+                                                   dtype=ing_dtype)
+            hist_stats["hist_quant"] = resolve_hist_quant(warn=True)
+            if efb_plan is not None:
+                hist_stats["efb_bundles"] = len(efb_plan.bundles)
+                hist_stats["efb_bundled_features"] = (
+                    efb_plan.n_bundled_features)
     group_ids_dev = None if group_ids is None else jnp.asarray(group_ids)
     if cfg.objective == "lambdarank" and group_ids is not None:
         # host-computed padded (G, S) bucket layout, built ONCE from the
@@ -1694,19 +2193,32 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
                     f"valid set {vi}: ndcg eval requires its own "
                     f"group ids (pass 4-tuples in valid_sets)")
 
-    if cfg.boosting_type == "dart" or custom_objective is not None:
-        trees, tree_weights, evals, best_iter = _train_loop(
-            cfg, k, num_f, total_bins, depth, binned_d, labels_d, weights_d,
-            group_ids_dev, raw, valid_states, custom_objective, mesh,
-            metric_name, metric_list, higher_better, metric_kwargs,
-            base_score, callbacks, measures, n, row_valid,
-            iteration_offset, group_layout=group_layout)
-    else:
-        trees, tree_weights, evals, best_iter = _train_scan(
-            cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
-            group_ids_dev, raw, valid_states, mesh,
-            metric_list, higher_better, base_score, callbacks, measures,
-            row_valid_d, iteration_offset, group_layout=group_layout)
+    try:
+        if (cfg.boosting_type == "dart" or custom_objective is not None
+                or grow_policy == "leafwise"):
+            trees, tree_weights, evals, best_iter = _train_loop(
+                cfg, k, num_f, total_bins, depth, binned_d, labels_d,
+                weights_d, group_ids_dev, raw, valid_states,
+                custom_objective, mesh, metric_name, metric_list,
+                higher_better, metric_kwargs, base_score, callbacks,
+                measures, n, row_valid, iteration_offset,
+                group_layout=group_layout, hist_token=hist_token_d,
+                binned_hist=binned_hist_d, efb_plan=efb_plan,
+                leafwise=grow_policy == "leafwise")
+        else:
+            trees, tree_weights, evals, best_iter = _train_scan(
+                cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
+                group_ids_dev, raw, valid_states, mesh,
+                metric_list, higher_better, base_score, callbacks,
+                measures, row_valid_d, iteration_offset,
+                group_layout=group_layout, hist_token=hist_token_d,
+                binned_hist=binned_hist_d, efb_plan=efb_plan)
+    finally:
+        # the loops drain every dispatched step before returning
+        # (block_until_ready / eager device_get), so no histogram
+        # callback can run after this release
+        for tok in host_tokens:
+            _release_host_binned(tok)
     trees_sf, trees_tb, trees_nv, trees_cnt, trees_dt, trees_bgl = trees
 
     num_trees = len(trees_sf)
@@ -1782,13 +2294,15 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
     )
     if init_model is not None:
         booster = BoosterArrays.concat(init_model, booster)
-    return TrainResult(booster=booster, evals=evals, best_iteration=best_iter)
+    return TrainResult(booster=booster, evals=evals,
+                       best_iteration=best_iter, hist_stats=hist_stats)
 
 
 def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
                 group_ids_dev, raw, valid_states, mesh,
                 metric_list, higher_better, base_score, callbacks, measures,
-                row_valid_d=None, iteration_offset=0, group_layout=None):
+                row_valid_d=None, iteration_offset=0, group_layout=None,
+                hist_token=None, binned_hist=None, efb_plan=None):
     """Fused device loop: one async dispatch per iteration, zero host
     syncs inside the loop. Early stopping syncs the (tiny) metric matrix
     in blocks of ``early_stopping_round`` and truncates post hoc — trees
@@ -1804,10 +2318,13 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
 
     n_valid = len(valid_states)
     mode = _resolve_mode(cfg, mesh)
-    step_fn = _get_step_fn(num_f, total_bins, cfg, k, n_valid, mode, mesh)
+    step_fn = _get_step_fn(num_f, total_bins, cfg, k, n_valid, mode, mesh,
+                           efb_plan=efb_plan)
     ones = jnp.ones(labels_d.shape[0], jnp.float32)
     data = {
         "binned": binned_d,
+        "hist_token": hist_token,
+        "binned_hist": binned_hist,
         "labels": labels_d,
         "weights": weights_d if weights_d is not None else ones,
         "groups": group_ids_dev,
@@ -1980,7 +2497,8 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
                 custom_objective, mesh, metric_name, metric_list,
                 higher_better, metric_kwargs, base_score, callbacks,
                 measures, n, row_valid=None, iteration_offset=0,
-                group_layout=None):
+                group_layout=None, hist_token=None, binned_hist=None,
+                efb_plan=None, leafwise=False):
     """Per-iteration eager host loop. Used for (a) DART, whose
     dropped-tree set is a dynamically sized subset of all prior trees
     that doesn't fit a fixed-shape compiled step, and (b) custom
@@ -2000,7 +2518,12 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
     is_goss = cfg.boosting_type == "goss"
 
     mode = _resolve_mode(cfg, mesh)
-    build_tree = _get_builder(num_f, total_bins, cfg, mode, mesh)
+    if leafwise:
+        from mmlspark_tpu.models.gbdt.leafwise import make_build_tree_leafwise
+        build_tree = make_build_tree_leafwise(num_f, total_bins, cfg)
+    else:
+        build_tree = _get_builder(num_f, total_bins, cfg, mode, mesh,
+                                  efb_plan=efb_plan)
     predict_tree_binned = _get_predict_tree(depth)
     objective_fn = custom_objective or obj_mod.get_objective(cfg.objective)
     obj_kwargs = _objective_kwargs(cfg)
@@ -2130,6 +2653,11 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
                         jax.random.fold_in(jax.random.key(cfg.seed),
                                            4 + cls), cfg.extra_seed),
                         it + iteration_offset)
+                if not leafwise:
+                    if hist_token is not None:
+                        kw["hist_token"] = hist_token
+                    if binned_hist is not None:
+                        kw["binned_hist"] = binned_hist
                 sf, tb, nv, cnt, dt, bgl = build_tree(
                     binned_d, jnp.asarray(gc, jnp.float32),
                     jnp.asarray(hc, jnp.float32),

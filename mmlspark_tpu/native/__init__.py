@@ -13,11 +13,14 @@ from mmlspark_tpu.native.bindings import (
     ensure_built,
     is_available,
     level_histogram,
+    level_histogram_quant,
     load_csv,
     load_libsvm,
     murmur3_batch,
+    quant_histogram_available,
 )
 
 __all__ = ["NativeDataPlane", "ensure_built", "is_available",
            "load_csv", "load_libsvm", "murmur3_batch", "bin_matrix",
-           "level_histogram"]
+           "level_histogram", "level_histogram_quant",
+           "quant_histogram_available"]

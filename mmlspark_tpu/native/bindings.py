@@ -21,6 +21,7 @@ _SO_PATH = os.path.join(_NATIVE_DIR, "libmmlspark_native.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
+_quant_symbols = False
 
 
 def ensure_built() -> bool:
@@ -92,6 +93,29 @@ def _configure(lib: ctypes.CDLL) -> None:
         fn.restype = None
         fn.argtypes = [binp, i64, i64, f32p, f32p, f32p,
                        ctypes.POINTER(i32), i32, i32, f32p]
+    global _quant_symbols
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    _quant_symbols = True
+    for name, binp, qp in (
+            ("mmls_level_hist_q16_u8", u8p,
+             ctypes.POINTER(ctypes.c_int16)),
+            ("mmls_level_hist_q16_i32", ctypes.POINTER(i32),
+             ctypes.POINTER(ctypes.c_int16)),
+            ("mmls_level_hist_q8_u8", u8p,
+             ctypes.POINTER(ctypes.c_int8)),
+            ("mmls_level_hist_q8_i32", ctypes.POINTER(i32),
+             ctypes.POINTER(ctypes.c_int8))):
+        try:
+            fn = getattr(lib, name)
+        except AttributeError:
+            # stale pre-built .so from before the quantized kernels
+            # landed (rebuild failed): keep the f32 surface usable
+            _quant_symbols = False
+            break
+        fn.restype = None
+        fn.argtypes = [binp, i64, i64, qp, qp, u8p,
+                       ctypes.POINTER(i32), i32, i32,
+                       ctypes.c_float, ctypes.c_float, f32p]
 
 
 def is_available() -> bool:
@@ -194,6 +218,75 @@ def level_histogram(binned: np.ndarray, grad: np.ndarray,
             out[:, j, :, c] = np.bincount(
                 idx, weights=w, minlength=width * n_bins
             ).reshape(width, n_bins).astype(np.float32)
+    return sanitizer.check_finite(
+        "gbdt.level_hist", fault_point("gbdt.level_hist", out))
+
+
+def quant_histogram_available() -> bool:
+    """True when the loaded library exports the quantized kernels."""
+    return ensure_built() and _quant_symbols
+
+
+def level_histogram_quant(binned: np.ndarray, grad_q: np.ndarray,
+                          hess_q: np.ndarray, live: np.ndarray,
+                          local: np.ndarray, width: int, n_bins: int,
+                          gscale_inv: float, hscale_inv: float
+                          ) -> np.ndarray:
+    """Quantized GBDT per-level histogram: int16 (or int8) grad/hess
+    accumulated into int32 SIMD tiles with periodic folds into exact
+    int64 sums, dequantized once at the merge. ``live`` is a 0/1 uint8
+    gate. Bit-identical to the int64 bincount fallback below for any
+    worker count because the inverse scales are powers of two (the
+    single f32 rounding step happens after the exact integer sum).
+    """
+    n, f = binned.shape
+    qdt = np.int8 if grad_q.dtype == np.int8 else np.int16
+    grad_q = np.ascontiguousarray(grad_q, qdt)
+    hess_q = np.ascontiguousarray(hess_q, qdt)
+    live = np.ascontiguousarray(live, np.uint8)
+    local = np.ascontiguousarray(local, np.int32)
+    if quant_histogram_available():
+        if binned.dtype == np.uint8:
+            binned = np.ascontiguousarray(binned)
+            binp = ctypes.c_uint8
+            fn = (_lib.mmls_level_hist_q8_u8 if qdt == np.int8
+                  else _lib.mmls_level_hist_q16_u8)
+        else:
+            binned = np.ascontiguousarray(binned, np.int32)
+            binp = ctypes.c_int32
+            fn = (_lib.mmls_level_hist_q8_i32 if qdt == np.int8
+                  else _lib.mmls_level_hist_q16_i32)
+        qp = ctypes.c_int8 if qdt == np.int8 else ctypes.c_int16
+        out = np.empty((width, f, n_bins, 3), np.float32)
+        fn(binned.ctypes.data_as(ctypes.POINTER(binp)), n, f,
+           grad_q.ctypes.data_as(ctypes.POINTER(qp)),
+           hess_q.ctypes.data_as(ctypes.POINTER(qp)),
+           live.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+           local.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+           width, n_bins, gscale_inv, hscale_inv,
+           out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return sanitizer.check_finite(
+            "gbdt.level_hist", fault_point("gbdt.level_hist", out))
+    out = np.zeros((width, f, n_bins, 3), np.float32)
+    if n == 0:
+        return sanitizer.check_finite(
+            "gbdt.level_hist", fault_point("gbdt.level_hist", out))
+    gate = live != 0
+    idx_base = local.astype(np.int64) * n_bins
+    # float64 bincount of integer-valued weights is exact below 2^53,
+    # matching the native kernel's int64 accumulators bit-for-bit
+    chans = (np.where(gate, grad_q, 0).astype(np.float64),
+             np.where(gate, hess_q, 0).astype(np.float64),
+             gate.astype(np.float64))
+    scales = (np.float64(gscale_inv), np.float64(hscale_inv),
+              np.float64(1.0))
+    for j in range(f):
+        idx = idx_base + binned[:, j]
+        for c, (w, s) in enumerate(zip(chans, scales)):
+            sums = np.bincount(idx, weights=w,
+                               minlength=width * n_bins)
+            out[:, j, :, c] = (sums.reshape(width, n_bins)
+                               * s).astype(np.float32)
     return sanitizer.check_finite(
         "gbdt.level_hist", fault_point("gbdt.level_hist", out))
 

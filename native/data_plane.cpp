@@ -308,9 +308,9 @@ int mmls_libsvm_parse(const char* path, double* x, double* y,
 //     3-channel output ONCE per level in fixed worker order — the
 //     merge order is deterministic, so a given thread count reproduces
 //     bit-identical float sums;
-//   - while the tile fits L2 comfortably (shallow levels) rows are
-//     accumulated directly in one pass; once the tile outgrows L2
-//     (width x F x B x 16B beyond ~1 MiB) each worker first
+//   - while the tile stays cache-resident rows are accumulated
+//     directly in one pass; once the tile outgrows the budget
+//     (width x F x B x 16B beyond ~4 MiB) each worker first
 //     counting-sorts its row chunk by tree node into node-pure
 //     segments (a stable 1-pass bucket scatter of the bin rows plus
 //     the packed update vector), then accumulates segment by segment —
@@ -318,7 +318,19 @@ int mmls_libsvm_parse(const char* path, double* x, double* y,
 //     bench shape) regardless of level width. Both paths add into a
 //     given (node, feature, bin) cell in ascending row order, so they
 //     produce bit-identical sums and the crossover is purely a speed
-//     knob (measured 2x at width 32, 2M x 28 x 255 on one core);
+//     knob (at 2M x 28 x 255 the direct pass wins through width 32 —
+//     76 ms vs 87 ms sorted — because the random-bin scatter already
+//     misses L2 either way and the sort staging is pure overhead; the
+//     sorted pass only pays off once the tile spills last-level cache);
+//   - the quantized variants (mmls_level_hist_q16_* / _q8_*) take
+//     int16/int8 grad+hess with a shared power-of-two scale and a
+//     uint8 0/1 live gate, accumulate into per-worker int32 SIMD tiles,
+//     and periodically fold the tile into exact int64 accumulators
+//     (every 2^16 live rows for int16, 2^24 for int8 — chosen so a
+//     single cell can never reach INT32_MAX between folds). The merge
+//     multiplies the exact int64 sums by the inverse scales in double
+//     and rounds to f32 once, so the result is bit-identical to an
+//     int64 bincount reference regardless of worker count or path;
 //   - live == 0 rows are skipped before their bin row is touched
 //     (direct path) or dropped at partition time (sorted path), which
 //     is what makes the histogram-subtraction trick cheap here: the
@@ -327,12 +339,15 @@ int mmls_libsvm_parse(const char* path, double* x, double* y,
 // ---------------------------------------------------------------------------
 
 typedef float v4sf __attribute__((vector_size(16)));
+typedef int32_t v4si __attribute__((vector_size(16)));
 
 namespace {
 
 // direct-path crossover: above this tile size the node-partitioned
-// pass wins (tile no longer L2-resident)
-constexpr int64_t kHistL2Budget = 1 << 20;
+// pass wins. Measured at 2M x 28 x 255 rows on one core: direct beats
+// sorted at every level width up to 32 (3.6 MiB tile), so the budget
+// sits above that; sorted only helps once the tile spills LLC.
+constexpr int64_t kHistL2Budget = 1 << 22;
 
 template <typename BinT>
 void level_hist_chunk_direct(const BinT* binned, int64_t lo, int64_t hi,
@@ -436,6 +451,156 @@ void level_hist_typed(const BinT* binned, int64_t n, int64_t f,
   }
 }
 
+// --- quantized variants -----------------------------------------------------
+//
+// grad/hess arrive pre-scaled to int16 (|q| <= 32511) or int8
+// (|q| <= 126) by the trainer; live is a 0/1 uint8 gate (the trainer
+// keeps live binary — GOSS amplification is folded into grad/hess
+// before quantization). Accumulation runs in int32 SIMD tiles folded
+// into exact int64 accumulators every kFlushRows live rows, so no cell
+// can exceed INT32_MAX between folds: 2^16 * 32511 and 2^24 * 126 both
+// stay under 2^31.
+
+inline void hist_q_flush(v4si* tile, int64_t cells, int64_t* gacc,
+                         int64_t* hacc, int64_t* cacc) {
+  for (int64_t c = 0; c < cells; ++c) {
+    gacc[c] += tile[c][0];
+    hacc[c] += tile[c][1];
+    cacc[c] += tile[c][2];
+  }
+  std::memset(tile, 0, sizeof(v4si) * cells);
+}
+
+template <typename BinT, typename QT>
+void level_hist_q_chunk_direct(const BinT* binned, int64_t lo, int64_t hi,
+                               int64_t f, const QT* grad_q,
+                               const QT* hess_q, const uint8_t* live,
+                               const int32_t* local, int32_t n_bins,
+                               int64_t flush_rows, int64_t cells,
+                               v4si* tile, int64_t* gacc, int64_t* hacc,
+                               int64_t* cacc) {
+  int64_t since_flush = 0;
+  for (int64_t i = lo; i < hi; ++i) {
+    if (!live[i]) continue;
+    const BinT* brow = binned + i * f;
+    const v4si upd = {grad_q[i], hess_q[i], 1, 0};
+    v4si* nbase = tile + static_cast<int64_t>(local[i]) * f * n_bins;
+    for (int64_t j = 0; j < f; ++j) {
+      nbase[j * n_bins + static_cast<int64_t>(brow[j])] += upd;
+    }
+    if (++since_flush == flush_rows) {
+      hist_q_flush(tile, cells, gacc, hacc, cacc);
+      since_flush = 0;
+    }
+  }
+  hist_q_flush(tile, cells, gacc, hacc, cacc);
+}
+
+template <typename BinT, typename QT>
+void level_hist_q_chunk_sorted(const BinT* binned, int64_t lo, int64_t hi,
+                               int64_t f, const QT* grad_q,
+                               const QT* hess_q, const uint8_t* live,
+                               const int32_t* local, int32_t width,
+                               int32_t n_bins, int64_t flush_rows,
+                               int64_t cells, v4si* tile, int64_t* gacc,
+                               int64_t* hacc, int64_t* cacc) {
+  const int64_t n = hi - lo;
+  static thread_local std::vector<BinT> bins_buf;
+  static thread_local std::vector<v4si> upd_q_buf;
+  if (static_cast<int64_t>(bins_buf.size()) < n * f) bins_buf.resize(n * f);
+  if (static_cast<int64_t>(upd_q_buf.size()) < n) upd_q_buf.resize(n);
+  std::vector<int64_t> offsets(width + 1, 0);
+  for (int64_t i = lo; i < hi; ++i) {
+    if (live[i]) ++offsets[local[i] + 1];
+  }
+  for (int32_t w = 0; w < width; ++w) offsets[w + 1] += offsets[w];
+  std::vector<int64_t> cursor(offsets.begin(), offsets.end() - 1);
+  for (int64_t i = lo; i < hi; ++i) {
+    if (!live[i]) continue;
+    const int64_t pos = cursor[local[i]]++;
+    std::memcpy(bins_buf.data() + pos * f, binned + i * f,
+                sizeof(BinT) * f);
+    upd_q_buf[pos] = v4si{grad_q[i], hess_q[i], 1, 0};
+  }
+  int64_t since_flush = 0;
+  for (int32_t w = 0; w < width; ++w) {
+    v4si* nbase = tile + static_cast<int64_t>(w) * f * n_bins;
+    for (int64_t p = offsets[w]; p < offsets[w + 1]; ++p) {
+      const BinT* brow = bins_buf.data() + p * f;
+      const v4si upd = upd_q_buf[p];
+      for (int64_t j = 0; j < f; ++j) {
+        nbase[j * n_bins + static_cast<int64_t>(brow[j])] += upd;
+      }
+      if (++since_flush == flush_rows) {
+        hist_q_flush(tile, cells, gacc, hacc, cacc);
+        since_flush = 0;
+      }
+    }
+  }
+  hist_q_flush(tile, cells, gacc, hacc, cacc);
+}
+
+template <typename BinT, typename QT>
+void level_hist_q_typed(const BinT* binned, int64_t n, int64_t f,
+                        const QT* grad_q, const QT* hess_q,
+                        const uint8_t* live, const int32_t* local,
+                        int32_t width, int32_t n_bins, float gscale_inv,
+                        float hscale_inv, float* out) {
+  const int64_t cells = static_cast<int64_t>(width) * f * n_bins;
+  std::memset(out, 0, sizeof(float) * cells * 3);
+  if (n <= 0 || cells <= 0) return;
+  const int64_t flush_rows =
+      sizeof(QT) == 1 ? (int64_t{1} << 24) : (int64_t{1} << 16);
+  int workers = static_cast<int>(std::min<int64_t>(
+      hardware_threads(), std::max<int64_t>(n / 131072, 1)));
+  const bool sorted_path = cells * 16 > kHistL2Budget;
+
+  std::vector<std::vector<v4si>> tiles(workers);
+  std::vector<std::vector<int64_t>> accs(workers);
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + workers - 1) / workers;
+  for (int w = 0; w < workers; ++w) {
+    int64_t lo = w * chunk;
+    int64_t hi = std::min<int64_t>(n, lo + chunk);
+    if (lo >= hi) continue;
+    tiles[w].assign(cells, v4si{0, 0, 0, 0});
+    accs[w].assign(cells * 3, 0);
+    threads.emplace_back([&, w, lo, hi] {
+      int64_t* gacc = accs[w].data();
+      int64_t* hacc = gacc + cells;
+      int64_t* cacc = gacc + 2 * cells;
+      if (sorted_path) {
+        level_hist_q_chunk_sorted(binned, lo, hi, f, grad_q, hess_q,
+                                  live, local, width, n_bins, flush_rows,
+                                  cells, tiles[w].data(), gacc, hacc,
+                                  cacc);
+      } else {
+        level_hist_q_chunk_direct(binned, lo, hi, f, grad_q, hess_q,
+                                  live, local, n_bins, flush_rows, cells,
+                                  tiles[w].data(), gacc, hacc, cacc);
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  // int64 partials sum exactly (|sum| < 2^53 at any realistic n); the
+  // power-of-two inverse scales make the double product exact, so the
+  // f32 cast below is the single rounding step — bit-identical to an
+  // int64 bincount reference for any worker count or path.
+  std::vector<int64_t> total(cells * 3, 0);
+  for (int w = 0; w < workers; ++w) {
+    if (accs[w].empty()) continue;
+    const int64_t* acc = accs[w].data();
+    for (int64_t c = 0; c < cells * 3; ++c) total[c] += acc[c];
+  }
+  const double gs = static_cast<double>(gscale_inv);
+  const double hs = static_cast<double>(hscale_inv);
+  for (int64_t c = 0; c < cells; ++c) {
+    out[c * 3 + 0] = static_cast<float>(total[c] * gs);
+    out[c * 3 + 1] = static_cast<float>(total[cells + c] * hs);
+    out[c * 3 + 2] = static_cast<float>(total[2 * cells + c]);
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -454,6 +619,46 @@ void mmls_level_hist_i32(const int32_t* binned, int64_t n, int64_t f,
                          int32_t width, int32_t n_bins, float* out) {
   level_hist_typed(binned, n, f, grad, hess, live, local, width, n_bins,
                    out);
+}
+
+void mmls_level_hist_q16_u8(const uint8_t* binned, int64_t n, int64_t f,
+                            const int16_t* grad_q, const int16_t* hess_q,
+                            const uint8_t* live, const int32_t* local,
+                            int32_t width, int32_t n_bins,
+                            float gscale_inv, float hscale_inv,
+                            float* out) {
+  level_hist_q_typed(binned, n, f, grad_q, hess_q, live, local, width,
+                     n_bins, gscale_inv, hscale_inv, out);
+}
+
+void mmls_level_hist_q16_i32(const int32_t* binned, int64_t n, int64_t f,
+                             const int16_t* grad_q, const int16_t* hess_q,
+                             const uint8_t* live, const int32_t* local,
+                             int32_t width, int32_t n_bins,
+                             float gscale_inv, float hscale_inv,
+                             float* out) {
+  level_hist_q_typed(binned, n, f, grad_q, hess_q, live, local, width,
+                     n_bins, gscale_inv, hscale_inv, out);
+}
+
+void mmls_level_hist_q8_u8(const uint8_t* binned, int64_t n, int64_t f,
+                           const int8_t* grad_q, const int8_t* hess_q,
+                           const uint8_t* live, const int32_t* local,
+                           int32_t width, int32_t n_bins,
+                           float gscale_inv, float hscale_inv,
+                           float* out) {
+  level_hist_q_typed(binned, n, f, grad_q, hess_q, live, local, width,
+                     n_bins, gscale_inv, hscale_inv, out);
+}
+
+void mmls_level_hist_q8_i32(const int32_t* binned, int64_t n, int64_t f,
+                            const int8_t* grad_q, const int8_t* hess_q,
+                            const uint8_t* live, const int32_t* local,
+                            int32_t width, int32_t n_bins,
+                            float gscale_inv, float hscale_inv,
+                            float* out) {
+  level_hist_q_typed(binned, n, f, grad_q, hess_q, live, local, width,
+                     n_bins, gscale_inv, hscale_inv, out);
 }
 
 int64_t mmls_libsvm_dims(const char* path, int64_t* n_rows,

@@ -61,7 +61,7 @@ def _fit_data(n=1500, f=6, max_bin=64, seed=11):
     (999, 3, 255, 8, np.int32, "per_feature"),    # int32, full bin range
     (100, 5, 16, 16, np.uint8, "per_feature"),    # empty nodes
     (4096, 2, 64, 1, np.uint8, "per_feature"),    # root level
-    (3000, 4, 63, 32, np.uint8, "per_feature"),   # sorted C++ path
+    (3000, 4, 63, 32, np.uint8, "per_feature"),   # wide level, many nodes
 ])
 def test_native_matches_xla_formulations(n, f, b, width, bin_dtype, xla,
                                          monkeypatch):

@@ -1,0 +1,225 @@
+"""Quantized-gradient histogram parity (MMLSPARK_TPU_HIST_QUANT).
+
+The quantization contract (arXiv:2011.02022 applied to this engine):
+grad/hess round to int16/int8 under a shared power-of-two scale, bins
+accumulate exactly in integers, and dequantization is one float32
+multiply by the inverse (power-of-two) scale. That makes the native
+kernel, its numpy fallback, the XLA segment_sum mirror and the Pallas
+kernel agree to float32 SUMMATION ORDER only — and bit-for-bit
+wherever the sums are exact (counts always; grad/hess when per-cell
+int sums fit float32 exactly).
+
+The `quant_smoke` marker is the CI lint-workflow guardrail: small-N
+q16-vs-f32 end-to-end parity in well under a minute.
+"""
+
+import numpy as np
+import pytest
+
+import mmlspark_tpu.native.bindings as bindings_mod
+from mmlspark_tpu.core.env import env_override
+from mmlspark_tpu.models.gbdt import trainer as trainer_mod
+from mmlspark_tpu.models.gbdt.trainer import (
+    TrainConfig,
+    _level_histogram,
+    _level_histogram_quant,
+    _pow2_scale,
+    resolve_hist_quant,
+    train,
+)
+from mmlspark_tpu.ops.binning import BinMapper
+
+
+def _quant_case(n=3000, f=5, b=63, width=4, seed=0, qdt=np.int16):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    binned = rng.integers(0, b, size=(n, f)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = rng.uniform(0.1, 1.0, size=n).astype(np.float32)
+    live = (rng.random(n) < 0.9).astype(np.float32)
+    local = rng.integers(0, width, size=n).astype(np.int32)
+    qmax = 120.0 if qdt == np.int8 else 32000.0
+    gs, gsi = _pow2_scale(jnp.float32(np.abs(grad * live).max()), qmax)
+    hs, hsi = _pow2_scale(jnp.float32(np.abs(hess * live).max()), qmax)
+    gq = np.rint(grad * live * float(gs)).astype(qdt)
+    hq = np.rint(hess * live * float(hs)).astype(qdt)
+    return binned, gq, hq, live, local, float(gsi), float(hsi)
+
+
+def _exact_reference(binned, gq, hq, live, local, width, b, gsi, hsi):
+    """int64-exact bincount reference, one final f32 rounding — the
+    contract both the native kernel and its fallback implement."""
+    n, f = binned.shape
+    out = np.zeros((width, f, b, 3), np.float32)
+    gate = live != 0
+    idx_base = local.astype(np.int64) * b
+    chans = (np.where(gate, gq, 0).astype(np.float64),
+             np.where(gate, hq, 0).astype(np.float64),
+             gate.astype(np.float64))
+    scales = (np.float64(gsi), np.float64(hsi), np.float64(1.0))
+    for j in range(f):
+        idx = idx_base + binned[:, j]
+        for c, (w, s) in enumerate(zip(chans, scales)):
+            sums = np.bincount(idx, weights=w, minlength=width * b)
+            out[:, j, :, c] = (sums.reshape(width, b) * s).astype(
+                np.float32)
+    return out
+
+
+@pytest.mark.parametrize("qdt", [np.int16, np.int8])
+def test_native_kernel_bit_identical_to_exact_reference(qdt):
+    """int64 worker accumulators + a single f32 rounding by a pow2
+    inverse scale: the C++ kernel must reproduce the exact integer
+    reference bit-for-bit, any thread count, any path."""
+    binned, gq, hq, live, local, gsi, hsi = _quant_case(qdt=qdt, seed=2)
+    got = bindings_mod.level_histogram_quant(
+        binned, gq, hq, (live != 0).astype(np.uint8), local, 4, 63,
+        gsi, hsi)
+    ref = _exact_reference(binned, gq, hq, live, local, 4, 63, gsi, hsi)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_native_and_numpy_fallback_bit_identical(monkeypatch):
+    binned, gq, hq, live, local, gsi, hsi = _quant_case(seed=5)
+    lv = (live != 0).astype(np.uint8)
+    native = bindings_mod.level_histogram_quant(
+        binned, gq, hq, lv, local, 4, 63, gsi, hsi)
+    monkeypatch.setattr(bindings_mod, "quant_histogram_available",
+                        lambda: False)
+    fallback = bindings_mod.level_histogram_quant(
+        binned, gq, hq, lv, local, 4, 63, gsi, hsi)
+    np.testing.assert_array_equal(native, fallback)
+
+
+@pytest.mark.parametrize("qdt", [np.int16, np.int8])
+def test_three_formulations_agree(qdt):
+    """native callback vs XLA chunked segment_sum vs Pallas
+    (interpret): same dequantized values, f32-sum-order tolerance,
+    counts exact."""
+    import jax.numpy as jnp
+
+    binned, gq, hq, live, local, gsi, hsi = _quant_case(qdt=qdt, seed=7)
+    args = (jnp.asarray(binned), jnp.asarray(gq), jnp.asarray(hq),
+            jnp.asarray(live), jnp.asarray(local), 4, 5, 63,
+            jnp.float32(gsi), jnp.float32(hsi))
+    h_native = np.asarray(_level_histogram_quant(*args, "native"))
+    h_xla = np.asarray(_level_histogram_quant(*args, "per_feature"))
+    h_pallas = np.asarray(_level_histogram_quant(*args, "pallas"))
+    np.testing.assert_allclose(h_xla, h_native, rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(h_pallas, h_native, rtol=1e-5, atol=1e-4)
+    np.testing.assert_array_equal(h_xla[..., 2], h_native[..., 2])
+    np.testing.assert_array_equal(h_pallas[..., 2], h_native[..., 2])
+
+
+def test_empty_input_returns_zeros():
+    import jax.numpy as jnp
+
+    out = _level_histogram_quant(
+        jnp.zeros((0, 3), jnp.int32), jnp.zeros(0, jnp.int16),
+        jnp.zeros(0, jnp.int16), jnp.zeros(0, jnp.float32),
+        jnp.zeros(0, jnp.int32), 2, 3, 8, jnp.float32(1.0),
+        jnp.float32(1.0), "per_feature")
+    out = np.asarray(out)
+    assert out.shape == (2, 3, 8, 3)
+    assert not out.any()
+
+
+def _fit_case(n=6000, f=8, max_bin=64, seed=11):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f))
+    y = (x[:, 0] - 0.5 * x[:, 1] * x[:, 2]
+         + 0.1 * rng.normal(size=n) > 0).astype(np.float64)
+    return BinMapper.fit(x, max_bin=max_bin).transform(x), y
+
+
+def _split_agreement(b1, b2):
+    m = (b1.split_feature >= 0) | (b2.split_feature >= 0)
+    if not m.any():
+        return 1.0
+    return float(((b1.split_feature == b2.split_feature)
+                  & (b1.threshold_bin == b2.threshold_bin))[m].mean())
+
+
+@pytest.mark.quant_smoke
+@pytest.mark.parametrize("quant", ["q16", "q8"])
+def test_quantized_fit_parity_vs_f32(quant):
+    """End-to-end: a quantized fit must track the f32 fit. q16's
+    15-bit grid reproduces near-identical trees, so it is pinned at
+    the split level; q8's 7-bit grid legitimately picks different
+    (near-tied) splits as rounds compound, so it is pinned at the
+    quality level — root splits, prediction drift, and training loss
+    within quantization tolerance."""
+    binned, y = _fit_case()
+    cfg = TrainConfig(objective="binary", num_iterations=15,
+                      num_leaves=15, max_depth=5, min_data_in_leaf=20,
+                      seed=3)
+    with env_override("MMLSPARK_TPU_HIST_QUANT", None):
+        r_f32 = train(binned, y, cfg)
+    with env_override("MMLSPARK_TPU_HIST_QUANT", quant):
+        r_q = train(binned, y, cfg)
+    assert r_q.hist_stats["hist_quant"] == quant
+    assert r_f32.hist_stats["hist_quant"] == "off"
+    p_f32 = np.asarray(r_f32.booster.predict_binned_fn()(binned))
+    p_q = np.asarray(r_q.booster.predict_binned_fn()(binned))
+
+    def logloss(p):
+        p = np.clip(p, 1e-7, 1 - 1e-7)
+        return float(-(y * np.log(p) + (1 - y) * np.log(1 - p)).mean())
+
+    if quant == "q16":
+        assert _split_agreement(r_f32.booster, r_q.booster) >= 0.98
+        assert np.abs(p_f32 - p_q).mean() < 2e-3
+    else:
+        np.testing.assert_array_equal(r_q.booster.split_feature[:, 0],
+                                      r_f32.booster.split_feature[:, 0])
+        assert np.abs(p_f32 - p_q).mean() < 0.1
+    assert logloss(p_q) <= logloss(p_f32) * 1.05 + 1e-3
+
+
+@pytest.mark.quant_smoke
+def test_quantized_fit_deterministic_and_token_released():
+    """Same seed + q16 twice -> bit-identical boosters, and the
+    host-binned registry must be empty afterwards (the fit releases
+    its token even on the quantized path)."""
+    binned, y = _fit_case(n=3000, f=5)
+    cfg = TrainConfig(objective="binary", num_iterations=8,
+                      num_leaves=7, max_depth=4, seed=5)
+    with env_override("MMLSPARK_TPU_HIST_QUANT", "q16"):
+        r1 = train(binned, y, cfg)
+        r2 = train(binned, y, cfg)
+    for fld in ("split_feature", "threshold_bin", "node_value", "count"):
+        np.testing.assert_array_equal(getattr(r1.booster, fld),
+                                      getattr(r2.booster, fld))
+    assert trainer_mod._HOST_BINNED_REG == {}
+
+
+def test_quant_xla_backend_matches_native_backend_structure(monkeypatch):
+    """The same q16 fit through the native callback and through the
+    pure-XLA mirror must pick identical trees (dequantized operands
+    are identical; only f32 sum order differs)."""
+    binned, y = _fit_case(n=4000, f=6, seed=13)
+    cfg = TrainConfig(objective="binary", num_iterations=10,
+                      num_leaves=15, max_depth=5, seed=1)
+    with env_override("MMLSPARK_TPU_HIST_QUANT", "q16"):
+        r_native = train(binned, y, cfg)
+        with env_override("MMLSPARK_TPU_NATIVE_HIST", "0"):
+            r_xla = train(binned, y, cfg)
+    assert _split_agreement(r_native.booster, r_xla.booster) == 1.0
+
+
+def test_bad_quant_value_warns_once_and_downgrades(monkeypatch):
+    monkeypatch.setattr(trainer_mod, "_WARNED_BAD_QUANT", False)
+    with env_override("MMLSPARK_TPU_HIST_QUANT", "int4"):
+        with pytest.warns(UserWarning, match="HIST_QUANT"):
+            assert resolve_hist_quant() == "off"
+        # second resolution is silent (warn-once)
+        assert resolve_hist_quant() == "off"
+
+
+def test_quant_in_shard_map_downgrades_with_warning(monkeypatch):
+    monkeypatch.setattr(trainer_mod, "_WARNED_QUANT_SHARD", False)
+    with env_override("MMLSPARK_TPU_HIST_QUANT", "q16"):
+        with pytest.warns(UserWarning, match="shard"):
+            assert resolve_hist_quant(in_shard_map=True) == "off"
+        assert resolve_hist_quant(in_shard_map=False) == "q16"

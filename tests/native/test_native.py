@@ -104,21 +104,26 @@ class TestLevelHistogram:
         """The node-partitioned (sorted) C++ path must add into each
         (node, feature, bin) cell in the same ascending row order as
         the direct path: integer stats make every add exact, so folding
-        a width-32 (sorted-path) histogram onto width-4 node ids must
-        reproduce the direct-path width-4 histogram bit-for-bit."""
+        a width-64 (sorted-path) histogram onto width-4 node ids must
+        reproduce the direct-path width-4 histogram bit-for-bit.
+
+        The wide case's tile (64 * 17 * 255 * 16 B ≈ 4.4 MB) exceeds
+        kHistL2Budget (4 MB), so it actually takes the sorted path;
+        the width-4 fold target stays comfortably on the direct path —
+        the pairing the test exists to compare."""
         rng = np.random.default_rng(7)
-        n, f, b = 50000, 6, 63
+        n, f, b = 50000, 17, 255
         binned = rng.integers(0, b, size=(n, f)).astype(np.uint8)
         grad = rng.integers(-8, 9, size=n).astype(np.float32)
         hess = rng.integers(1, 9, size=n).astype(np.float32)
         live = np.ones(n, np.float32)
-        local32 = rng.integers(0, 32, size=n).astype(np.int32)
-        h32 = level_histogram(binned, grad, hess, live, local32, 32, b)
+        local64 = rng.integers(0, 64, size=n).astype(np.int32)
+        h64 = level_histogram(binned, grad, hess, live, local64, 64, b)
         h4 = level_histogram(binned, grad, hess, live,
-                             (local32 % 4).astype(np.int32), 4, b)
+                             (local64 % 4).astype(np.int32), 4, b)
         agg = np.zeros_like(h4)
-        for w in range(32):
-            agg[w % 4] += h32[w]
+        for w in range(64):
+            agg[w % 4] += h64[w]
         np.testing.assert_array_equal(agg, h4)
 
     def test_dead_rows_and_empty_nodes(self):

@@ -1,0 +1,197 @@
+"""Exclusive feature bundling (mmlspark_tpu.ops.efb).
+
+The invariant under test is EXACTNESS: the strict zero-conflict
+planner must only ever bundle features whose histograms are perfectly
+recoverable from the bundled column (arXiv:1706.08359 §4, without the
+approximate max_conflict_rate relaxation). Anything less silently
+corrupts split gains.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.env import env_override
+from mmlspark_tpu.ops import efb as efb_mod
+from mmlspark_tpu.ops.binning import BinMapper
+from mmlspark_tpu.ops.efb import apply_plan, plan_bundles, resolve_efb
+
+
+def _exclusive_matrix(n=5000, seed=0, n_bins=32):
+    """Three mutually-exclusive sparse columns (each row non-default in
+    at most one of them), one dense column, one independent sparse
+    column that conflicts with everything."""
+    rng = np.random.default_rng(seed)
+    x = np.zeros((n, 5), np.int32)
+    owner = rng.integers(0, 3, size=n)          # which sparse col owns the row
+    active = rng.random(n) < 0.6                # 40% rows all-default
+    for j in range(3):
+        rows = (owner == j) & active
+        x[rows, j] = rng.integers(1, 6, size=int(rows.sum()))
+    x[:, 3] = rng.integers(0, n_bins, size=n)   # dense: never bundled
+    x[:, 4] = rng.integers(1, 4, size=n)        # non-default everywhere
+    return x, n_bins
+
+
+def _histogram(binned, n_bins):
+    """(F, B) count histogram — the quantity EFB must preserve."""
+    f = binned.shape[1]
+    out = np.zeros((f, n_bins), np.int64)
+    for j in range(f):
+        out[j] = np.bincount(binned[:, j], minlength=n_bins)
+    return out
+
+
+def _unbundle_counts(bundled, plan):
+    """Reconstruct per-original-feature histograms from the bundled
+    matrix exactly the way the trainer does: scatter present slots,
+    default bin = total - present."""
+    n = bundled.shape[0]
+    out = np.zeros((plan.n_features, plan.n_bins), np.int64)
+    bcols, bbins, feats, obins = plan.scatter_arrays()
+    bhist = _histogram(bundled, plan.n_bins)
+    for c, bb, jf, ob in zip(bcols, bbins, feats, obins):
+        out[jf, ob] = bhist[c, bb]
+    dfeats, dbins = plan.member_default_arrays()
+    for jf, db in zip(dfeats, dbins):
+        out[jf, db] = n - out[jf].sum()
+    pcols, pfeats = plan.passthrough_arrays()
+    for c, jf in zip(pcols, pfeats):
+        out[jf] = bhist[c]
+    return out
+
+
+def test_mutually_exclusive_features_bundle_into_one_column():
+    x, n_bins = _exclusive_matrix()
+    plan = plan_bundles(x, n_bins)
+    assert plan is not None
+    assert len(plan.bundles) == 1
+    assert sorted(m.feature for m in plan.bundles[0]) == [0, 1, 2]
+    # dense col 3 and always-conflicting col 4 stay passthrough
+    assert set(plan.passthrough) == {3, 4}
+    assert plan.n_cols == 3
+    assert plan.n_bundled_features == 3
+
+
+def test_conflicting_features_are_never_bundled():
+    """Two columns non-default on overlapping rows must not share a
+    bundle, even when each is individually sparse."""
+    rng = np.random.default_rng(3)
+    n = 4000
+    x = np.zeros((n, 3), np.int32)
+    hot = rng.random(n) < 0.2
+    x[hot, 0] = rng.integers(1, 5, size=int(hot.sum()))
+    x[hot, 1] = rng.integers(1, 5, size=int(hot.sum()))  # same rows: conflict
+    x[:, 2] = rng.integers(0, 16, size=n)
+    assert plan_bundles(x, 16) is None
+
+
+def test_single_shared_row_blocks_bundle():
+    """Conflict detection is exact over ALL rows — one colliding row
+    outside any plausible sample must block the bundle."""
+    n = 200_000
+    x = np.zeros((n, 2), np.int32)
+    x[: n // 10, 0] = 1                  # 10% non-default, disjoint
+    x[n // 10 : n // 5, 1] = 1           # ranges -> zero conflicts
+    x[0, 1] = 2          # row 0 is non-default in BOTH columns
+    assert plan_bundles(x, 8, sample_rows=1000) is None
+    x[0, 1] = 0          # remove the collision -> bundle forms
+    plan = plan_bundles(x, 8, sample_rows=1000)
+    assert plan is not None and len(plan.bundles) == 1
+
+
+def test_dense_input_returns_none():
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 32, size=(3000, 6)).astype(np.int32)
+    assert plan_bundles(x, 32) is None
+
+
+def test_apply_plan_roundtrips_histograms_exactly():
+    x, n_bins = _exclusive_matrix(seed=7)
+    plan = plan_bundles(x, n_bins)
+    bundled = apply_plan(x, plan)
+    assert bundled.dtype == x.dtype
+    assert bundled.shape == (x.shape[0], plan.n_cols)
+    assert int(bundled.max()) < n_bins
+    np.testing.assert_array_equal(_unbundle_counts(bundled, plan),
+                                  _histogram(x, n_bins))
+
+
+def test_slot_budget_respected():
+    """A bundle never encodes more distinct non-default bins than
+    n_bins - 1 (slot 0 is reserved for all-default). With 3 exclusive
+    features of 5 observed bins each and a budget of 11, exactly one
+    pair bundles and the third feature is forced out."""
+    x, _ = _exclusive_matrix(seed=5)
+    plan = plan_bundles(x, n_bins=12)
+    assert plan is not None
+    for bundle in plan.bundles:
+        used = sum(len(m.vals) for m in bundle)
+        assert used <= 12 - 1
+        assert max(m.offset + len(m.vals) for m in bundle) == used
+    assert plan.n_bundled_features == 2
+    assert len(plan.passthrough) == 3
+
+
+def test_cache_key_distinguishes_plans():
+    x, n_bins = _exclusive_matrix(seed=0)
+    y, _ = _exclusive_matrix(seed=9)
+    p1 = plan_bundles(x, n_bins)
+    p2 = plan_bundles(x, n_bins)
+    p3 = plan_bundles(y, n_bins)
+    assert p1.cache_key == p2.cache_key
+    if p3 is not None and p3 != p1:
+        assert p3.cache_key != p1.cache_key
+
+
+def test_resolve_efb_values_and_bad_value_warns_once(monkeypatch):
+    with env_override("MMLSPARK_TPU_EFB", None):
+        assert resolve_efb() == "auto"
+    for v in ("auto", "off", "on"):
+        with env_override("MMLSPARK_TPU_EFB", v):
+            assert resolve_efb() == v
+    monkeypatch.setattr(efb_mod, "_WARNED_BAD_EFB", False)
+    with env_override("MMLSPARK_TPU_EFB", "yes_please"):
+        with pytest.warns(UserWarning, match="EFB"):
+            assert resolve_efb() == "auto"
+        assert resolve_efb() == "auto"   # warn-once
+
+
+def test_efb_fit_preserves_trees_and_records_stats():
+    """End-to-end: an EFB-on fit of bundleable data must pick the SAME
+    splits (original feature ids, original threshold bins) as the
+    EFB-off fit — bundling is invisible outside histogram construction
+    — and hist_stats must report the bundle counts."""
+    from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
+
+    rng = np.random.default_rng(21)
+    n = 6000
+    x = np.zeros((n, 6))
+    owner = rng.integers(0, 3, size=n)
+    active = rng.random(n) < 0.5
+    for j in range(3):
+        rows = (owner == j) & active
+        x[rows, j] = rng.normal(loc=2.0, size=int(rows.sum()))
+    x[:, 3:] = rng.normal(size=(n, 3))
+    y = ((x[:, 0] - x[:, 1] + 0.8 * x[:, 3]
+          + 0.1 * rng.normal(size=n)) > 0).astype(np.float64)
+    binned = BinMapper.fit(x, max_bin=64).transform(x)
+
+    cfg = TrainConfig(objective="binary", num_iterations=10,
+                      num_leaves=15, max_depth=5, min_data_in_leaf=20,
+                      seed=2)
+    with env_override("MMLSPARK_TPU_EFB", "off"):
+        r_off = train(binned, y, cfg)
+    with env_override("MMLSPARK_TPU_EFB", "on"):
+        r_on = train(binned, y, cfg)
+
+    assert r_off.hist_stats["efb_bundles"] == 0
+    assert r_on.hist_stats["efb_bundles"] >= 1
+    assert r_on.hist_stats["efb_bundled_features"] >= 2
+    np.testing.assert_array_equal(r_on.booster.split_feature,
+                                  r_off.booster.split_feature)
+    np.testing.assert_array_equal(r_on.booster.threshold_bin,
+                                  r_off.booster.threshold_bin)
+    # values reconstruct through total-minus-present in f32: tiny drift
+    np.testing.assert_allclose(r_on.booster.node_value,
+                               r_off.booster.node_value,
+                               rtol=1e-4, atol=1e-4)

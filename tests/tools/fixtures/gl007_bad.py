@@ -32,3 +32,17 @@ def narrowed_f64(x):
     # float64 host accumulate, silently narrowed at the jit boundary
     acc = np.asarray(x, np.float64)
     return step(acc)
+
+
+def sub32_segment_accumulate(grad, binned, b):
+    # quantized int16 gradients summed directly: segment_sum's
+    # accumulator inherits int16 and overflows within ~2 rows per bin
+    # at qmax-scale magnitudes
+    gq = jnp.rint(grad * 32000.0).astype(jnp.int16)
+    return jax.ops.segment_sum(gq, binned[:, 0], num_segments=b)
+
+
+def sub32_scatter_accumulate(hist, grad, binned):
+    # same class through the scatter-add spelling
+    gq = jnp.rint(grad * 120.0).astype(jnp.int8)
+    return hist.at[binned[:, 0]].add(gq)

@@ -39,3 +39,23 @@ def narrowed_explicitly(x):
     acc = np.asarray(x, np.float64)
     acc32 = acc.astype(np.float32)   # intentional, visible narrowing
     return step(acc32)
+
+
+def chunked_rescale(grad, binned, b):
+    # the periodic-rescale idiom: each chunk's int32 partial is exact
+    # (chunk_rows * qmax < 2**31), the running accumulator is float32 —
+    # the widening casts clear the sub-32-bit taint
+    gq = jnp.rint(grad * 32000.0).astype(jnp.int16)
+    acc = jnp.zeros(b, jnp.float32)
+    chunk = 1 << 16
+    for s in range(0, gq.shape[0], chunk):
+        part = jax.ops.segment_sum(
+            gq[s:s + chunk].astype(jnp.int32),
+            binned[s:s + chunk, 0], num_segments=b)
+        acc = acc + part.astype(jnp.float32)
+    return acc
+
+
+def widened_scatter(hist, grad, binned):
+    gq = jnp.rint(grad * 120.0).astype(jnp.int8)
+    return hist.at[binned[:, 0]].add(gq.astype(jnp.int32))

@@ -248,7 +248,7 @@ def test_gl006_no_false_positive_on_real_builders():
 def test_gl007_catches_narrow_index_products():
     found = lint([FIXTURES / "gl007_bad.py"], select=["GL007"])
     msgs = messages(found)
-    assert len(found) == 4, msgs
+    assert len(found) == 6, msgs
     overflow = [m for m in msgs if "overflows int32" in m]
     assert len(overflow) == 3, msgs
     assert any("arange" in m for m in overflow)
@@ -256,12 +256,17 @@ def test_gl007_catches_narrow_index_products():
     assert any(".at[flat].add" in m for m in overflow)
     assert any("silently narrowed to float32" in m and "'step'" in m
                for m in msgs), msgs
+    sub32 = [m for m in msgs if "sub-32-bit" in m]
+    assert len(sub32) == 2, msgs
+    assert any("segment_sum" in m for m in sub32)
+    assert any(".add" in m for m in sub32)
     assert all(f.rule == "GL007" for f in found)
 
 
 def test_gl007_clean_fixture_passes():
-    # 2-factor products, node-local indexing, int64-widened products
-    # and explicit float32 casts must all pass
+    # 2-factor products, node-local indexing, int64-widened products,
+    # explicit float32 casts, and the chunked periodic-rescale
+    # (int16 -> per-chunk int32 -> float32 accumulator) must all pass
     assert lint([FIXTURES / "gl007_clean.py"], select=["GL007"]) == []
 
 

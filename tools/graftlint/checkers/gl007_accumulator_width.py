@@ -21,6 +21,17 @@ Two silent-corruption classes in the histogram and scatter paths:
    disabled by default). An explicit ``astype``/``asarray`` to another
    dtype kills the taint — intentional narrowing is fine; *silent*
    narrowing is the bug.
+
+3. **sub-32-bit accumulation.** ``segment_sum``/``.at[...].add`` keep
+   the operand dtype as the accumulator dtype, so int16/int8 data
+   (MMLSPARK_TPU_HIST_QUANT-style quantized gradients) summed over a
+   large segment overflows silently — int16 holds only ~2 quantized
+   values of magnitude qmax=32000 per bin. The fix is the periodic-
+   rescale idiom (``trainer._level_histogram_quant``'s XLA mirror):
+   chunk the rows so each chunk's int32 partial is exact, widen the
+   operand (``astype(jnp.int32)``) per chunk, and fold partials into a
+   float32/int64 accumulator. As with rule 1, any widening cast in the
+   dataflow chain absolves — it IS the fix.
 """
 
 from __future__ import annotations
@@ -40,8 +51,10 @@ class AccumulatorWidthChecker(Checker):
     rule = "GL007"
     name = "accumulator-width"
     description = ("row-scaled int32 flat-index products (n*F*B) "
-                   "feeding segment_sum/scatter, and silent "
-                   "float64->float32 narrowing across jit boundaries")
+                   "feeding segment_sum/scatter, silent "
+                   "float64->float32 narrowing across jit boundaries, "
+                   "and sub-32-bit (int8/int16) accumulation into "
+                   "segment_sum/.at[].add without a widening cast")
 
     def check_file(self, pf: ParsedFile,
                    project: Project) -> List[Finding]:
@@ -69,6 +82,7 @@ class AccumulatorWidthChecker(Checker):
                                                            "float64")))
         i64 = Analysis(fn, ExprTokens(source=_dtype_source(pf,
                                                            "int64")))
+        sub32 = Analysis(fn, ExprTokens(source=_sub32_source(pf)))
         out: List[Finding] = []
         seen: Set[int] = set()
         for call in calls:
@@ -79,6 +93,8 @@ class AccumulatorWidthChecker(Checker):
                 pf, call, stmt, row, i64, defs, def_nodes, seen))
             out.extend(self._check_narrowing(
                 pf, call, stmt, f64, jit_callables))
+            out.extend(self._check_sub32_accumulation(
+                pf, call, stmt, sub32))
         return out
 
     # -- rule 1: int32 flat-index products ---------------------------------
@@ -144,6 +160,50 @@ class AccumulatorWidthChecker(Checker):
                          "segment ids that stay < 2**31"))
         return out
 
+    # -- rule 3: sub-32-bit accumulation -----------------------------------
+
+    def _check_sub32_accumulation(self, pf, call, stmt,
+                                  sub32) -> List[Finding]:
+        """int16/int8-tainted DATA summed by segment_sum or
+        ``.at[...].add``: the accumulator inherits the operand dtype,
+        so the sum overflows long before the indices do. A widening
+        cast anywhere on the data chain clears the taint (dtype-source
+        kill), which is exactly the chunked periodic-rescale fix."""
+        resolved = pf.imports.resolve_node(call.func) or ""
+        data_exprs: List[ast.expr] = []
+        if resolved.split(".")[-1] == "segment_sum":
+            if call.args:
+                data_exprs.append(call.args[0])
+            data_exprs.extend(kw.value for kw in call.keywords
+                              if kw.arg == "data")
+        elif (isinstance(call.func, ast.Attribute)
+              and call.func.attr == "add"
+              and isinstance(call.func.value, ast.Subscript)
+              and isinstance(call.func.value.value, ast.Attribute)
+              and call.func.value.value.attr == "at"):
+            data_exprs.extend(call.args)
+        else:
+            return []
+        env = sub32.env_at(stmt)
+        out: List[Finding] = []
+        for expr in data_exprs:
+            if "sub32" not in sub32.eval_expr(expr, env):
+                continue
+            out.append(Finding(
+                rule=self.rule, severity="error", path=pf.rel,
+                line=call.lineno, col=call.col_offset,
+                message=f"sub-32-bit (int8/int16) data accumulated by "
+                        f"{pf.line_text(call.lineno)[:40]!r} — the "
+                        f"accumulator inherits the operand dtype and "
+                        f"overflows within a few thousand quantized "
+                        f"rows per bin",
+                hint="apply the periodic-rescale idiom: chunk the rows "
+                     "so an int32 partial is exact, widen per chunk "
+                     "(astype(jnp.int32)) and fold partials into a "
+                     "float32/int64 accumulator (see "
+                     "trainer._level_histogram_quant)"))
+        return out
+
     # -- rule 2: float64 narrowing ------------------------------------------
 
     def _check_narrowing(self, pf, call, stmt, f64,
@@ -199,6 +259,22 @@ def _dtype_source(pf: ParsedFile, want: str):
             return frozenset({label})
         if d is not None:
             return frozenset()   # explicit cast to something else: kill
+        return None
+    return source
+
+
+def _sub32_source(pf: ParsedFile):
+    """Taint source for sub-32-bit integer evidence: a cast to
+    int16/int8 seeds 'sub32'; an explicit cast to any wider dtype
+    kills it (that widening is the periodic-rescale fix)."""
+    def source(expr: ast.AST) -> Optional[Tokens]:
+        if not isinstance(expr, ast.Call):
+            return None
+        d = _cast_dtype(pf, expr)
+        if d in ("int16", "int8", "uint16", "uint8"):
+            return frozenset({"sub32"})
+        if d is not None:
+            return frozenset()   # widened (or float): kill
         return None
     return source
 
