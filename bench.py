@@ -216,8 +216,8 @@ def main():
         # quant/EFB/grow-policy provenance from the timed fit itself
         # (result.hist_stats), not a re-resolution that could disagree
         **{k: result.hist_stats.get(k)
-           for k in ("grow_policy", "hist_quant", "efb_bundles",
-                     "efb_bundled_features")},
+           for k in ("grow_policy", "hist_quant", "hist_shard",
+                     "efb_bundles", "efb_bundled_features")},
         "graftsan_enabled": sanitizer.enabled(),
         "graftsan_disabled_overhead_ns": (
             round(san_disabled_ns, 1) if san_disabled_ns is not None

@@ -66,8 +66,10 @@ HIST_SUB = register(
     "1/0 force the histogram-subtraction trick on/off; unset = native-"
     "kernel-only default")
 PALLAS_HIST = register(
-    "MMLSPARK_TPU_PALLAS_HIST", "flag", False,
-    "=1 opts into the Pallas TPU histogram kernel")
+    "MMLSPARK_TPU_PALLAS_HIST", "flag", None,
+    "Pallas TPU histogram kernel: default ON on the TPU backend (the "
+    "sharded reduction no longer assumes a replicated histogram), off "
+    "elsewhere; =1/=0 force")
 PALLAS_FORCE_COMPILE = register(
     "MMLSPARK_TPU_PALLAS_FORCE_COMPILE", "flag", False,
     "=1 compiles Pallas kernels through Mosaic even off-TPU (AOT "
@@ -122,6 +124,14 @@ EFB = register(
     "exclusive feature bundling for histogram construction: auto|off|on"
     " — auto gates the planner on a sampled sparsity estimate, on "
     "forces planning even for dense-looking data")
+HIST_SHARD = register(
+    "MMLSPARK_TPU_HIST_SHARD", "str", "auto",
+    "data-parallel histogram reduction sharding: auto|off|on — "
+    "reduce-scatter (psum_scatter) the per-level histogram across dp "
+    "so each replica owns a feature slice and selects its splits "
+    "locally (arXiv:2004.13336); auto enables it when dp>1 and the "
+    "config supports it, on forces (warn-once downgrade when "
+    "unsupported), off keeps the full-psum GSPMD path")
 GROW_POLICY = register(
     "MMLSPARK_TPU_GROW_POLICY", "str", "depthwise",
     "tree growth policy: depthwise|leafwise; leafwise drives splits by "

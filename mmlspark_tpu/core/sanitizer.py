@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import warnings
 from contextlib import contextmanager
 from typing import Any, Iterator, List, Optional, Sequence, Tuple
 
@@ -232,14 +233,40 @@ def use_recorder(r: CollectiveRecorder) -> Iterator[CollectiveRecorder]:
         _active_recorder = prev
 
 
+# the collective vocabulary the sequence cross-check understands —
+# kept in sync with graftlint GL001's COLLECTIVES table (axis-bearing
+# jax.lax primitives) so a builder cannot record an op the static
+# checkers don't model. reduce_scatter is jax's psum_scatter; both
+# spellings are accepted because the paper/XLA literature names the op
+# ReduceScatter while jax.lax exposes psum_scatter.
+KNOWN_COLLECTIVES = frozenset((
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "psum_scatter", "reduce_scatter",
+    "pbroadcast", "pcast",
+))
+
+_warned_unknown_ops: set = set()
+
+
 def record_collective(op: str, axis: Any, shape: Any = None,
                       dtype: Any = None) -> None:
     """Instrumentation hook placed next to each collective inside a
     shard_map body. Executes at *trace time* (it is host code), so it
     fires once per compilation and records exactly the collective
-    protocol the compiled program will follow — zero per-step cost."""
+    protocol the compiled program will follow — zero per-step cost.
+
+    ``op`` should come from :data:`KNOWN_COLLECTIVES`; an unknown kind
+    still records (the cross-check hashes whatever sequence traced) but
+    warns once per op, since a typo'd kind would silently weaken the
+    divergence check's diagnostics."""
     if not _enabled:
         return
+    if op not in KNOWN_COLLECTIVES and op not in _warned_unknown_ops:
+        _warned_unknown_ops.add(op)
+        warnings.warn(
+            f"graftsan: record_collective got unknown collective kind "
+            f"{op!r} (known: {sorted(KNOWN_COLLECTIVES)}); recording "
+            f"anyway", stacklevel=2)
     recorder().record(op, axis, shape, dtype)
 
 

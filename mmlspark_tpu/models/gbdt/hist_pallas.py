@@ -44,11 +44,17 @@ _BIN_PAD = 256   # bin axis padded to two full lane tiles
 
 
 def pallas_histogram_enabled() -> bool:
-    """Opt-in until a real-TPU measurement picks the default
-    (bench_hist.py measures this kernel against the XLA formulations;
-    ROUND4 notes record the decision)."""
+    """Default ON on the TPU backend, opt-in elsewhere: with the
+    sharded histogram reduction no longer assuming a replicated
+    histogram (parallel_modes.make_build_tree_data_parallel), the
+    Mosaic kernel is the production per-shard path on TPU.
+    MMLSPARK_TPU_PALLAS_HIST=1/0 forces either way (off-TPU the kernel
+    runs in interpret mode — correctness testing, not a default)."""
+    import jax
+
     from mmlspark_tpu.core.env import env_flag
-    return env_flag("MMLSPARK_TPU_PALLAS_HIST")
+    return env_flag("MMLSPARK_TPU_PALLAS_HIST",
+                    default=jax.default_backend() == "tpu")
 
 
 def _hist_kernel(bn_ref, bins_ref, data_ref, out_ref, *, num_features: int,

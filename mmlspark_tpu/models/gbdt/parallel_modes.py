@@ -258,6 +258,267 @@ def make_build_tree_voting(num_features: int, total_bins: int, cfg,
         check_vma=_check_vma(total_bins))
 
 
+def hist_reduction_bytes(num_features: int, total_bins: int, depth: int,
+                         dp: int, sharded: bool) -> int:
+    """Analytic per-device histogram-reduction payload for ONE tree:
+    bytes of reduced histogram each replica materializes across all
+    levels (f32 stats triple per (node, feature, bin) cell), plus — in
+    the sharded mode — the small winner-combine tensors (the gathered
+    per-shard gains and the masked-psum broadcast of the winning
+    feature/bin/child-stat tuples). This is the quantity the
+    reduce-scatter drops by ~dp: the full-psum path delivers the whole
+    (width, F, B, 3) tensor to every replica per level, the sharded
+    path only its F/dp feature slice."""
+    f_pad = ((num_features + dp - 1) // dp) * dp
+    total = 0
+    for d in range(depth):
+        width = 2 ** d
+        full = width * num_features * total_bins * 3 * 4
+        if not sharded:
+            total += full
+            continue
+        slice_bytes = width * f_pad * total_bins * 3 * 4 // dp
+        combine = (dp * width * 4          # all_gather of per-shard gains
+                   + 2 * width * 4         # best_feat/best_bin psums
+                   + 2 * width * 3 * 4)    # left/total child-stat psums
+        total += slice_bytes + combine
+    return total
+
+
+def make_build_tree_data_parallel(num_features: int, total_bins: int,
+                                  cfg, mesh,
+                                  shard_hist: bool = True) -> Callable:
+    """Data-parallel builder with a reduce-scattered histogram:
+    shard_map over ``dp`` with ROW-SHARDED binned/grad/hess/valid (the
+    same signature as the serial builder). Instead of materializing the
+    full ``(width, F, B, 3)`` reduced histogram on every replica (the
+    GSPMD full-``psum`` path), the per-level histogram is
+    ``psum_scatter``'d across ``dp`` so each replica receives only its
+    contiguous feature slice, split gain/threshold selection runs on
+    the owned slice locally, and only the winning (feature, bin, gain,
+    child-stats) tuples are combined — per-chip histogram memory and
+    reduction bytes drop ~dp× (the cross-replica sharded-update scheme
+    of arXiv:2004.13336 applied to histogram reduction).
+
+    ``shard_hist=False`` builds the explicit full-``psum`` twin — same
+    per-shard histogram partials, full reduction, full local selection
+    — used by the parity tests to pin the reduce-scatter path bitwise
+    against the full reduction.
+
+    Bitwise contract with the serial builder: the split-selection math
+    below mirrors the serial numerical path op-for-op (cumsum gains,
+    masked-sum child stats, first-max argmax tie-break, path_smooth /
+    max_delta_step handling), and features are sharded in contiguous
+    ascending slices so the cross-shard winner combine (lowest shard
+    wins ties, first flat index within a shard) reproduces the serial
+    flat argmax exactly. Features are zero-padded to a multiple of dp;
+    padded columns carry zero stats and a zeroed feat_mask, so they
+    never win. Unsupported configs (categorical/monotone/extra_trees/
+    per-node feature sampling) are screened by
+    ``trainer._hist_shard_supported``."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from mmlspark_tpu.core.jax_compat import shard_map
+    from mmlspark_tpu.parallel.mesh import axis_size
+
+    depth = cfg.effective_depth
+    num_slots = 2 ** (depth + 1) - 1
+    b = total_bins
+    f = num_features
+    dp = axis_size(mesh, DATA_AXIS)
+    f_pad = ((f + dp - 1) // dp) * dp
+    f_loc = f_pad // dp
+    leaf_objective = _leaf_objective_fns(cfg)
+    path_smooth = float(cfg.path_smooth)
+    max_delta_step = float(cfg.max_delta_step)
+
+    def _clip_delta(v):
+        if max_delta_step > 0:
+            return jnp.clip(v, -max_delta_step, max_delta_step)
+        return v
+
+    # the reduction + split-selection step is chosen HERE, outside the
+    # traced body, so every rank traces one unconditional collective
+    # sequence (GL006: no collectives under a branch)
+
+    def _sharded_select(hist, feat_mask, shard, width):
+        # ---- reduce-scatter: each replica receives ONLY its feature
+        # slice of the summed histogram -------------------------------
+        feat_off = shard * f_loc
+        own_ids = feat_off + jnp.arange(f_loc)
+        # owned-slice feat mask: zero past F, so padded columns (and
+        # per-tree-masked features) never win
+        own_mask = jnp.where(own_ids < f,
+                             feat_mask[jnp.minimum(own_ids, f - 1)], 0.0)
+        hist_p = jnp.pad(hist, ((0, 0), (0, f_pad - f), (0, 0), (0, 0)))
+        record_collective("psum_scatter", DATA_AXIS, hist_p.shape,
+                          hist_p.dtype)
+        hist_loc = jax.lax.psum_scatter(
+            hist_p, DATA_AXIS, scatter_dimension=1, tiled=True)
+
+        # ---- owned-slice split selection (serial math on the slice;
+        # first-max flat argmax within the slice) ---------------------
+        gain, _ = _split_gains(hist_loc, leaf_objective, cfg, b)
+        gain = jnp.where(own_mask[None, :, None] > 0, gain, -jnp.inf)
+        flat = gain.reshape(width, f_loc * b)
+        loc_fb = jnp.argmax(flat, axis=1)
+        loc_gain = jnp.take_along_axis(flat, loc_fb[:, None], 1)[:, 0]
+        loc_feat = (loc_fb // b).astype(jnp.int32) + feat_off
+        loc_bin = (loc_fb % b).astype(jnp.int32)
+
+        # ---- combine per-shard bests: slices are ascending, so argmax
+        # over shards (first max) == the serial flat argmax -----------
+        record_collective("all_gather", DATA_AXIS, loc_gain.shape,
+                          loc_gain.dtype)
+        gains_all = jax.lax.all_gather(loc_gain, DATA_AXIS)
+        winner = jnp.argmax(gains_all, axis=0)              # (width,)
+        best_gain = jnp.max(gains_all, axis=0)
+        i_am_winner = winner == shard
+        zero = jnp.zeros_like(loc_feat)
+        record_collective("psum", DATA_AXIS, loc_feat.shape,
+                          loc_feat.dtype)
+        record_collective("psum", DATA_AXIS, loc_bin.shape,
+                          loc_bin.dtype)
+        best_feat = jax.lax.psum(
+            jnp.where(i_am_winner, loc_feat, zero), DATA_AXIS)
+        best_bin = jax.lax.psum(
+            jnp.where(i_am_winner, loc_bin, zero), DATA_AXIS)
+
+        # ---- child stats: winner supplies (serial masked-sum
+        # formulation), masked psums broadcast ------------------------
+        sel = jnp.arange(width)
+        loc_best_idx = (loc_fb // b).astype(jnp.int32)
+        hist_best = hist_loc[sel, loc_best_idx]      # (width, B, 3)
+        left_mask = jnp.arange(b)[None, :] <= loc_bin[:, None]
+        left_loc = jnp.sum(hist_best * left_mask[..., None], axis=1)
+        tot_loc = jnp.sum(hist_best, axis=1)
+        record_collective("psum", DATA_AXIS, left_loc.shape,
+                          left_loc.dtype)
+        record_collective("psum", DATA_AXIS, tot_loc.shape,
+                          tot_loc.dtype)
+        left_stats = jax.lax.psum(
+            jnp.where(i_am_winner[:, None], left_loc, 0.0), DATA_AXIS)
+        tot_stats = jax.lax.psum(
+            jnp.where(i_am_winner[:, None], tot_loc, 0.0), DATA_AXIS)
+        return best_feat, best_bin, best_gain, left_stats, tot_stats
+
+    def _full_select(hist, feat_mask, shard, width):
+        # full-psum twin: every replica reduces the whole histogram and
+        # selects identically (serial math on the full tensor)
+        del shard
+        record_collective("psum", DATA_AXIS, hist.shape, hist.dtype)
+        hist_full = jax.lax.psum(hist, DATA_AXIS)
+        gain, _ = _split_gains(hist_full, leaf_objective, cfg, b)
+        gain = jnp.where(feat_mask[None, :, None] > 0, gain, -jnp.inf)
+        flat = gain.reshape(width, f * b)
+        best_fb = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best_fb[:, None], 1)[:, 0]
+        best_feat = (best_fb // b).astype(jnp.int32)
+        best_bin = (best_fb % b).astype(jnp.int32)
+        sel = jnp.arange(width)
+        hist_best = hist_full[sel, best_feat]        # (width, B, 3)
+        left_mask = jnp.arange(b)[None, :] <= best_bin[:, None]
+        left_stats = jnp.sum(hist_best * left_mask[..., None], axis=1)
+        tot_stats = jnp.sum(hist_best, axis=1)
+        return best_feat, best_bin, best_gain, left_stats, tot_stats
+
+    select = _sharded_select if shard_hist else _full_select
+
+    def local_fn(binned, grad, hess, valid, feat_mask, remaining_leaves):
+        n = binned.shape[0]
+        shard = jax.lax.axis_index(DATA_AXIS)
+
+        node = jnp.zeros(n, dtype=jnp.int32)
+        done = jnp.zeros(n, dtype=jnp.bool_)
+        split_feature = jnp.full(num_slots, -1, dtype=jnp.int32)
+        threshold_bin = jnp.zeros(num_slots, dtype=jnp.int32)
+        node_value = jnp.zeros(num_slots, dtype=jnp.float32)
+        node_count = jnp.zeros(num_slots, dtype=jnp.float32)
+
+        root = jnp.stack([jnp.sum(grad * valid), jnp.sum(hess * valid),
+                          jnp.sum(valid)])
+        record_collective("psum", DATA_AXIS, root.shape, root.dtype)
+        root = jax.lax.psum(root, DATA_AXIS)
+        rv, _ = leaf_objective(root[0], root[1])
+        node_value = node_value.at[0].set(_clip_delta(rv))
+        node_count = node_count.at[0].set(root[2])
+        remaining = remaining_leaves - 1
+
+        for d in range(depth):
+            level_start = 2 ** d - 1
+            width = 2 ** d
+            local = jnp.clip(node - level_start, 0, width - 1)
+            live = (~done).astype(grad.dtype) * valid
+
+            hist = _histogram(binned, grad, hess, live, local, width, f, b)
+
+            (best_feat, best_bin, best_gain,
+             left_stats, tot_stats) = select(hist, feat_mask, shard,
+                                             width)
+            right_stats = tot_stats - left_stats
+
+            can_split = jnp.isfinite(best_gain)
+            order = jnp.argsort(-jnp.where(can_split, best_gain, -jnp.inf))
+            rank = jnp.zeros(width, dtype=jnp.int32).at[order].set(
+                jnp.arange(width, dtype=jnp.int32))
+            do_split = can_split & (rank < remaining)
+            remaining = remaining - jnp.sum(do_split.astype(jnp.int32))
+
+            slots = level_start + jnp.arange(width)
+            split_feature = split_feature.at[slots].set(
+                jnp.where(do_split, best_feat, -1))
+            threshold_bin = threshold_bin.at[slots].set(
+                jnp.where(do_split, best_bin, 0))
+
+            lval, _ = leaf_objective(left_stats[:, 0], left_stats[:, 1])
+            rval, _ = leaf_objective(right_stats[:, 0], right_stats[:, 1])
+            if path_smooth > 0:
+                pv = node_value[slots]
+                wl = left_stats[:, 2] / (left_stats[:, 2] + path_smooth)
+                wr = right_stats[:, 2] / (right_stats[:, 2] + path_smooth)
+                lval = lval * wl + pv * (1.0 - wl)
+                rval = rval * wr + pv * (1.0 - wr)
+            lval = _clip_delta(lval)
+            rval = _clip_delta(rval)
+            lslots, rslots = 2 * slots + 1, 2 * slots + 2
+            node_value = node_value.at[lslots].set(
+                jnp.where(do_split, lval, 0.0))
+            node_value = node_value.at[rslots].set(
+                jnp.where(do_split, rval, 0.0))
+            node_count = node_count.at[lslots].set(
+                jnp.where(do_split, left_stats[:, 2], 0.0))
+            node_count = node_count.at[rslots].set(
+                jnp.where(do_split, right_stats[:, 2], 0.0))
+
+            # ---- route local rows (all features present locally) -------
+            nfeat = best_feat[local]
+            nbin = jnp.take_along_axis(binned, nfeat[:, None], 1)[:, 0]
+            nsplit = do_split[local]
+            go_left = nbin <= best_bin[local]
+            child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
+            newly_done = ~nsplit & ~done
+            node = jnp.where(done | ~nsplit, node, child)
+            done = done | newly_done
+
+        # every shard computed identical tree state (all cross-shard
+        # values went through psum/all_gather); pmax is an identity that
+        # marks them dp-invariant so out_specs=P() typechecks
+        for v in (split_feature, threshold_bin, node_value, node_count):
+            record_collective("pmax", DATA_AXIS, v.shape, v.dtype)
+        return tuple(jax.lax.pmax(v, DATA_AXIS) for v in
+                     (split_feature, threshold_bin, node_value,
+                      node_count))
+
+    row = P(DATA_AXIS)
+    return shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), row, row, row, P(), P()),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=_check_vma(total_bins))
+
+
 def make_build_tree_feature_parallel(num_features: int, total_bins: int,
                                      cfg, mesh) -> Callable:
     """Feature-parallel builder: shard_map over ``fp``; binned and

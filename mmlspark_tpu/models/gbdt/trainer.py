@@ -369,9 +369,10 @@ def resolve_hist_quant(in_shard_map: bool = False,
             _WARNED_QUANT_SHARD = True
             import warnings
             warnings.warn(
-                "MMLSPARK_TPU_HIST_QUANT is single-program only; the "
-                "shard_map tree learners build f32 histograms — label "
-                "A/B measurements accordingly", stacklevel=2)
+                "MMLSPARK_TPU_HIST_QUANT is single-program only; "
+                "sharded (data/voting/feature-parallel) fits build f32 "
+                "histograms — label A/B measurements accordingly",
+                stacklevel=2)
         return "off"
     return raw
 
@@ -421,6 +422,84 @@ def _leafwise_supported(cfg: "TrainConfig", mesh) -> Optional[str]:
     if cfg.feature_fraction_by_node < 1.0:
         return "feature_fraction_by_node"
     return None
+
+
+_WARNED_BAD_SHARD = False
+_WARNED_SHARD_DOWNGRADE_DP = False
+
+_VALID_SHARD = ("auto", "off", "on")
+
+
+def resolve_hist_shard(warn: bool = True) -> str:
+    """Raw MMLSPARK_TPU_HIST_SHARD policy value (auto|off|on, default
+    auto). ``auto`` turns the sharded reduction on exactly when the fit
+    is data-parallel over dp>1 and :func:`_hist_shard_supported` allows
+    the config; ``on`` forces it, downgrading with one warning when the
+    config cannot honor it; ``off`` keeps the legacy full-psum GSPMD
+    path. Bad values warn once and run auto (core.env contract)."""
+    global _WARNED_BAD_SHARD
+    raw = (env_str("MMLSPARK_TPU_HIST_SHARD", "") or "").strip().lower()
+    if not raw:
+        return "auto"
+    if raw not in _VALID_SHARD:
+        if warn and not _WARNED_BAD_SHARD:
+            _WARNED_BAD_SHARD = True
+            import warnings
+            warnings.warn(
+                f"MMLSPARK_TPU_HIST_SHARD={raw!r} is not one of "
+                "auto|off|on; using auto", stacklevel=2)
+        return "auto"
+    return raw
+
+
+def _hist_shard_supported(cfg: "TrainConfig", mesh) -> Optional[str]:
+    """None when the reduce-scatter data-parallel builder can honor
+    this config bitwise-identically to the full-psum path, else the
+    human-readable reason for staying on the GSPMD path."""
+    if mesh is None:
+        return "no device mesh is attached"
+    if cfg.tree_learner in ("voting", "feature"):
+        return f"tree_learner={cfg.tree_learner!r}"
+    from mmlspark_tpu.parallel.mesh import axis_size
+    if axis_size(mesh, "dp") < 2:
+        return "dp axis size is 1"
+    if cfg.categorical_features:
+        return "categorical_features"
+    if any(cfg.monotone_constraints or ()):
+        return "monotone_constraints"
+    if cfg.extra_trees:
+        return "extra_trees"
+    if cfg.feature_fraction_by_node < 1.0:
+        return "feature_fraction_by_node"
+    return None
+
+
+def resolve_hist_shard_mode(cfg: "TrainConfig", mesh,
+                            warn: bool = True
+                            ) -> Tuple[str, Optional[str]]:
+    """(resolved mode, downgrade reason): ``("on", None)`` routes the
+    fit through the explicit reduce-scatter shard_map builder,
+    ``("off", reason-or-None)`` keeps the full-psum path. A forced
+    ``on`` that the config cannot honor warns once (honest A/B
+    labeling, as the leafwise/quant downgrades); ``auto`` downgrades
+    silently — off is simply its resolution for unsupported fits."""
+    global _WARNED_SHARD_DOWNGRADE_DP
+    raw = resolve_hist_shard(warn=warn)
+    if raw == "off":
+        return "off", None
+    reason = _hist_shard_supported(cfg, mesh)
+    if reason is None:
+        return "on", None
+    if raw == "on":
+        if warn and not _WARNED_SHARD_DOWNGRADE_DP:
+            _WARNED_SHARD_DOWNGRADE_DP = True
+            import warnings
+            warnings.warn(
+                "MMLSPARK_TPU_HIST_SHARD=on cannot shard the histogram "
+                f"reduction for this fit ({reason}); running the "
+                "full-psum path — label A/B measurements accordingly",
+                stacklevel=2)
+    return "off", reason
 
 
 _WARNED_ASYNC_CALLBACK = False
@@ -1515,11 +1594,18 @@ def _loop_only_normalized(cfg: TrainConfig) -> TrainConfig:
 
 
 def _resolve_mode(cfg: TrainConfig, mesh) -> str:
-    """Distributed tree-learner mode: explicit shard_map builders only
-    exist for voting/feature; everything else is the serial builder
-    (which GSPMD data-parallelizes when inputs are row-sharded)."""
-    return cfg.tree_learner if (cfg.tree_learner in ("voting", "feature")
-                                and mesh is not None) else "serial"
+    """Distributed tree-learner mode: explicit shard_map builders exist
+    for voting/feature (selected by ``tree_learner``) and for the
+    data-parallel reduce-scatter path (``data_sharded``, selected by
+    MMLSPARK_TPU_HIST_SHARD when the config supports it); everything
+    else is the serial builder (which GSPMD data-parallelizes when
+    inputs are row-sharded, with a full-histogram allreduce)."""
+    if cfg.tree_learner in ("voting", "feature") and mesh is not None:
+        return cfg.tree_learner
+    if mesh is not None and resolve_hist_shard_mode(
+            cfg, mesh, warn=False)[0] == "on":
+        return "data_sharded"
+    return "serial"
 
 
 def _with_bin_mask(fn, total_bins):
@@ -1555,6 +1641,13 @@ def _get_builder(num_f: int, total_bins: int, cfg: TrainConfig, mode: str,
             fn = _with_bin_mask(
                 make_build_tree_feature_parallel(num_f, total_bins, cfg,
                                                  mesh),
+                total_bins)
+        elif mode == "data_sharded":
+            from mmlspark_tpu.models.gbdt.parallel_modes import (
+                make_build_tree_data_parallel)
+            fn = _with_bin_mask(
+                make_build_tree_data_parallel(num_f, total_bins, cfg,
+                                              mesh),
                 total_bins)
         else:
             # serial builder under a mesh = GSPMD auto-partitioning,
@@ -1644,6 +1737,7 @@ def _hist_env_key() -> tuple:
             env_str("MMLSPARK_TPU_HIST_SUB", "").strip(),
             env_str("MMLSPARK_TPU_NATIVE_HIST", "").strip(),
             env_str("MMLSPARK_TPU_HIST_QUANT", "").strip(),
+            env_str("MMLSPARK_TPU_HIST_SHARD", "").strip(),
             native_histogram_available(),
             sync_state)
 
@@ -2107,9 +2201,24 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
         hist_token_d = None
         binned_hist_d = None
         host_tokens: List[int] = []
+        # resolved shard mode is recorded for EVERY fit (serial fits
+        # trivially "off") so a multi-device A/B is attributable from
+        # hist_stats alone; forced-on downgrades warn once inside
+        # resolve_hist_shard_mode
+        shard_mode, shard_reason = resolve_hist_shard_mode(cfg, mesh,
+                                                           warn=True)
         hist_stats: Dict[str, object] = {
             "grow_policy": grow_policy, "hist_quant": "off",
+            "hist_shard": shard_mode,
             "efb_bundles": 0, "efb_bundled_features": 0}
+        if mesh is not None and shard_reason is not None:
+            hist_stats["hist_shard_reason"] = shard_reason
+        if mesh is not None and resolve_hist_quant(warn=False) != "off":
+            # the quantized accumulation is single-program only; sharded
+            # fits (GSPMD full-psum AND the explicit builders) keep f32
+            # histograms — warn once and record the honest resolution
+            # instead of the old silent serial-only downgrade
+            resolve_hist_quant(in_shard_map=True, warn=True)
         if (mesh is None and _resolve_mode(cfg, mesh) == "serial"
                 and grow_policy == "depthwise"):
             serial_formulation = resolve_histogram_formulation(

@@ -1,0 +1,234 @@
+"""Reduce-scatter histogram sharding (MMLSPARK_TPU_HIST_SHARD) on the
+8-device CPU mesh.
+
+The pinned contract: fits through the sharded data-parallel builder
+(psum_scatter feature slices + owned-slice split selection) are
+BITWISE-identical — trees and predictions — to the full-psum path, at
+every dp that divides the device count, including feature counts the
+dp axis does not divide. Plus the policy surface: hist_stats
+attribution, forced-on downgrade warnings, and the interactions with
+histogram subtraction, the leafwise downgrade, and quantized
+histograms (all of which the sharded path must ignore bitwise).
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.env import env_override
+from mmlspark_tpu.core import sanitizer as san
+from mmlspark_tpu.models.gbdt import trainer as trainer_mod
+from mmlspark_tpu.models.gbdt.parallel_modes import (
+    hist_reduction_bytes, make_build_tree_data_parallel)
+from mmlspark_tpu.models.gbdt.trainer import (TrainConfig,
+                                              resolve_hist_shard, train)
+from mmlspark_tpu.ops.binning import BinMapper
+from mmlspark_tpu.parallel.mesh import MeshConfig, create_mesh
+
+
+def _data(n=1024, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f))
+    logit = 1.5 * x[:, 0] - x[:, 1] + 0.5 * x[:, 2]
+    y = (logit + rng.normal(size=n) * 0.3 > 0).astype(np.float64)
+    return x, y
+
+
+def _fit(x, y, mesh, shard, max_bin=32, **cfg_kw):
+    mapper = BinMapper.fit(x, max_bin=max_bin)
+    base = dict(objective="binary", num_iterations=4, num_leaves=15,
+                max_depth=4, min_data_in_leaf=5, max_bin=max_bin)
+    base.update(cfg_kw)
+    cfg = TrainConfig(**base)
+    with env_override("MMLSPARK_TPU_HIST_SHARD", shard):
+        return train(mapper.transform(x), y, cfg,
+                     bin_upper=mapper.bin_upper_values(max_bin),
+                     mesh=mesh)
+
+
+def _assert_bitwise_trees(a, b):
+    np.testing.assert_array_equal(a.booster.split_feature,
+                                  b.booster.split_feature)
+    np.testing.assert_array_equal(a.booster.threshold_bin,
+                                  b.booster.threshold_bin)
+    assert np.array_equal(np.asarray(a.booster.node_value),
+                          np.asarray(b.booster.node_value))
+    assert np.array_equal(np.asarray(a.booster.count),
+                          np.asarray(b.booster.count))
+
+
+@pytest.fixture(scope="module")
+def dp8():
+    return create_mesh(MeshConfig(dp=8))
+
+
+@pytest.fixture(scope="module", params=[2, 4, 8])
+def dp_mesh(request):
+    import jax
+    dp = request.param
+    return create_mesh(MeshConfig(dp=dp), devices=jax.devices()[:dp])
+
+
+class TestBitwiseParity:
+    def test_sharded_matches_full_psum_at_every_dp(self, dp_mesh):
+        """Trees AND predictions bitwise-equal at dp=2/4/8, with a
+        feature count (10) the dp axis does not divide — the padded
+        columns must never win a split."""
+        x, y = _data()
+        on = _fit(x, y, dp_mesh, "on")
+        off = _fit(x, y, dp_mesh, "off")
+        assert on.hist_stats["hist_shard"] == "on"
+        assert off.hist_stats["hist_shard"] == "off"
+        _assert_bitwise_trees(on, off)
+        assert np.array_equal(np.asarray(on.booster.predict_fn()(x)),
+                              np.asarray(off.booster.predict_fn()(x)))
+
+    @pytest.mark.shard_smoke
+    def test_auto_resolves_on_and_matches_full_psum(self, dp8):
+        """auto (the default) routes dp>1 fits through the sharded
+        builder; the CI smoke pins the bitwise contract at dp=8."""
+        x, y = _data(n=512, f=8)
+        auto = _fit(x, y, dp8, None, num_iterations=3)   # unset -> auto
+        off = _fit(x, y, dp8, "off", num_iterations=3)
+        assert auto.hist_stats["hist_shard"] == "on"
+        _assert_bitwise_trees(auto, off)
+
+    def test_subtraction_interaction(self, dp8):
+        """The sharded builder never subtracts (sibling compaction is
+        data-dependent): forcing HIST_SUB either way must not change a
+        sharded fit's bits."""
+        x, y = _data()
+        with env_override("MMLSPARK_TPU_HIST_SUB", "1"):
+            sub_on = _fit(x, y, dp8, "on")
+        with env_override("MMLSPARK_TPU_HIST_SUB", "0"):
+            sub_off = _fit(x, y, dp8, "on")
+        assert sub_on.hist_stats["hist_shard"] == "on"
+        _assert_bitwise_trees(sub_on, sub_off)
+
+    def test_leafwise_downgrade_interaction(self, dp8):
+        """GROW_POLICY=leafwise downgrades to depthwise under a mesh;
+        the sharded reduction must compose with that downgrade and stay
+        bitwise-equal to the full-psum fit of the same downgrade."""
+        import warnings as w
+        x, y = _data()
+        with env_override("MMLSPARK_TPU_GROW_POLICY", "leafwise"):
+            with w.catch_warnings():
+                w.simplefilter("ignore")
+                on = _fit(x, y, dp8, "on")
+                off = _fit(x, y, dp8, "off")
+        assert on.hist_stats["grow_policy"] == "depthwise"
+        assert on.hist_stats["hist_shard"] == "on"
+        _assert_bitwise_trees(on, off)
+
+    def test_quant_downgrade_interaction(self, dp8, monkeypatch):
+        """HIST_QUANT under a mesh warns once, records hist_quant=off,
+        and leaves the sharded fit's bits untouched."""
+        x, y = _data(n=512, f=8)
+        plain = _fit(x, y, dp8, "on", num_iterations=3)
+        monkeypatch.setattr(trainer_mod, "_WARNED_QUANT_SHARD", False)
+        with env_override("MMLSPARK_TPU_HIST_QUANT", "q16"):
+            with pytest.warns(UserWarning, match="single-program only"):
+                quant = _fit(x, y, dp8, "on", num_iterations=3)
+        assert quant.hist_stats["hist_quant"] == "off"
+        assert quant.hist_stats["hist_shard"] == "on"
+        _assert_bitwise_trees(plain, quant)
+
+
+class TestShardOwnership:
+    def test_uneven_features_builder_twin(self, dp8):
+        """Direct builder-level contract for features % dp != 0: the
+        psum_scatter path and its full-psum twin produce bitwise-equal
+        trees, and no padded feature id (>= F) ever wins a split."""
+        import jax.numpy as jnp
+        rng = np.random.default_rng(7)
+        n, f, b = 512, 10, 16
+        binned = rng.integers(0, b, size=(n, f)).astype(np.int32)
+        grad = rng.normal(size=n).astype(np.float32)
+        hess = np.ones(n, dtype=np.float32)
+        valid = np.ones(n, dtype=np.float32)
+        feat_mask = np.ones(f, dtype=np.float32)
+        cfg = TrainConfig(num_leaves=15, max_depth=4, min_data_in_leaf=5,
+                          max_bin=b)
+        args = (jnp.asarray(binned), jnp.asarray(grad),
+                jnp.asarray(hess), jnp.asarray(valid),
+                jnp.asarray(feat_mask), jnp.int32(15))
+        sharded = make_build_tree_data_parallel(f, b, cfg, dp8,
+                                                shard_hist=True)(*args)
+        full = make_build_tree_data_parallel(f, b, cfg, dp8,
+                                             shard_hist=False)(*args)
+        for s_arr, f_arr in zip(sharded, full):
+            assert np.array_equal(np.asarray(s_arr), np.asarray(f_arr))
+        sf = np.asarray(sharded[0])
+        assert sf.max() < f and sf.min() >= -1
+        assert (sf >= 0).any()  # the fit actually split
+
+    def test_reduction_bytes_accounting(self):
+        """The analytic payload model behind the MULTICHIP metrics:
+        sharded bytes approach full/dp as the combine overhead
+        amortizes, and dp=1 sharding is a no-op in the model."""
+        full = hist_reduction_bytes(256, 64, 6, 8, sharded=False)
+        shard = hist_reduction_bytes(256, 64, 6, 8, sharded=True)
+        assert full == sum((2 ** d) * 256 * 64 * 3 * 4 for d in range(6))
+        assert full / shard > 6.0   # ~8x minus combine overhead
+        assert hist_reduction_bytes(256, 64, 6, 1, sharded=True) >= \
+            hist_reduction_bytes(256, 64, 6, 1, sharded=False)
+
+
+class TestPolicy:
+    def test_serial_fit_records_off(self):
+        x, y = _data(n=256, f=4)
+        res = _fit(x, y, None, None, num_iterations=2)
+        assert res.hist_stats["hist_shard"] == "off"
+        assert "hist_shard_reason" not in res.hist_stats
+
+    def test_unsupported_learner_records_reason(self, dp8):
+        x, y = _data(n=512, f=8)
+        res = _fit(x, y, dp8, None, num_iterations=2,
+                   tree_learner="voting", top_k=8)
+        assert res.hist_stats["hist_shard"] == "off"
+        assert "voting" in res.hist_stats["hist_shard_reason"]
+
+    def test_forced_on_downgrade_warns_once(self, dp8, monkeypatch):
+        x, y = _data(n=512, f=8)
+        monkeypatch.setattr(trainer_mod, "_WARNED_SHARD_DOWNGRADE_DP",
+                            False)
+        with pytest.warns(UserWarning, match="cannot shard"):
+            res = _fit(x, y, dp8, "on", num_iterations=2,
+                       tree_learner="voting", top_k=8)
+        assert res.hist_stats["hist_shard"] == "off"
+
+    def test_forced_on_without_mesh_warns_once(self, monkeypatch):
+        """Forcing =on on a mesh-less fit is still a downgrade the
+        user asked not to have — same warn-once contract as the
+        unsupported-config case, no silent fallback."""
+        x, y = _data(n=256, f=4)
+        monkeypatch.setattr(trainer_mod, "_WARNED_SHARD_DOWNGRADE_DP",
+                            False)
+        with pytest.warns(UserWarning, match="no device mesh"):
+            res = _fit(x, y, None, "on", num_iterations=2)
+        assert res.hist_stats["hist_shard"] == "off"
+
+    def test_bad_value_warns_and_runs_auto(self, monkeypatch):
+        monkeypatch.setattr(trainer_mod, "_WARNED_BAD_SHARD", False)
+        with env_override("MMLSPARK_TPU_HIST_SHARD", "bogus"):
+            with pytest.warns(UserWarning, match="HIST_SHARD"):
+                assert resolve_hist_shard() == "auto"
+
+    def test_sanitizer_records_psum_scatter(self, dp8):
+        """The collective protocol the sharded builder compiles must
+        show the reduce-scatter to graftsan's divergence cross-check."""
+        trainer_mod._CHUNK_CACHE.clear()
+        trainer_mod._BUILDER_CACHE.clear()
+        san.enable()
+        try:
+            rec = san.CollectiveRecorder()
+            x, y = _data(n=512, f=8)
+            with san.use_recorder(rec):
+                _fit(x, y, dp8, "on", num_iterations=2)
+            ops = [e[0] for e in rec.events]
+            assert "psum_scatter" in ops
+            assert "all_gather" in ops
+        finally:
+            san.disable()
+            san.reset()
+        trainer_mod._CHUNK_CACHE.clear()
+        trainer_mod._BUILDER_CACHE.clear()

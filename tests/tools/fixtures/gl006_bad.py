@@ -35,6 +35,16 @@ def data_dependent_collective(x, threshold):
     return x
 
 
+@jax.jit
+def rank_gated_reduce_scatter(x):
+    # the sharded-histogram collective under a rank gate: ranks that
+    # skip the reduce-scatter leave the others blocked in it
+    if lax.axis_index(DATA_AXIS) == 0:
+        x = lax.psum_scatter(x, DATA_AXIS, scatter_dimension=0,
+                             tiled=True)
+    return x
+
+
 USE_TWO_PHASE = True
 
 
@@ -47,4 +57,17 @@ def mismatched_branches(x):
         x = lax.all_gather(x, DATA_AXIS)
     else:
         x = lax.all_gather(x, DATA_AXIS)
+    return x
+
+
+@jax.jit
+def mismatched_scatter_branches(x):
+    # reduce-scatter + gather on one arm vs full psum on the other:
+    # same result shape, different collective protocol (warning)
+    if USE_TWO_PHASE:
+        x = lax.psum_scatter(x, DATA_AXIS, scatter_dimension=0,
+                             tiled=True)
+        x = lax.all_gather(x, DATA_AXIS, tiled=True)
+    else:
+        x = lax.psum(x, DATA_AXIS)
     return x

@@ -59,6 +59,30 @@ def version_gated_wrapper(x, axes):
 
 
 @jax.jit
+def sharded_histogram_reduction(x):
+    # the reduce-scatter protocol of the sharded data-parallel builder:
+    # scatter the reduction, work the owned slice, gather the winners —
+    # every rank runs the identical unconditional sequence
+    part = lax.psum_scatter(x, DATA_AXIS, scatter_dimension=0,
+                            tiled=True)
+    best = lax.all_gather(jnp.max(part, axis=0), DATA_AXIS)
+    return best
+
+
+@jax.jit
+def agreeing_scatter_branches(x):
+    # rank-tainted predicate, but both arms issue the same
+    # psum_scatter sequence: no divergence
+    if jax.process_index() == 0:
+        y = lax.psum_scatter(x * 2.0, DATA_AXIS, scatter_dimension=0,
+                             tiled=True)
+    else:
+        y = lax.psum_scatter(x, DATA_AXIS, scatter_dimension=0,
+                             tiled=True)
+    return y
+
+
+@jax.jit
 def none_gate(x, weights=None):
     # `is None` on an argument is resolved at trace time
     if weights is None:

@@ -218,14 +218,18 @@ def test_gl006_catches_divergent_collectives():
     msgs = messages(found)
     errors = [f for f in found if f.severity == "error"]
     warns = [f for f in found if f.severity == "warning"]
-    assert len(errors) == 3 and len(warns) == 1, msgs
+    assert len(errors) == 4 and len(warns) == 2, msgs
     assert any("'psum'" in m and "'if' predicate tainted by rank "
                "identity" in m for m in msgs), msgs
+    assert any("'psum_scatter'" in m and "'if' predicate tainted by "
+               "rank identity" in m for m in msgs), msgs
     assert any("'all_gather'" in m and "'while' predicate" in m
                for m in msgs), msgs
     assert any("control-dependent on traced data" in m
                for m in msgs), msgs
     assert any("mismatched collective sequences" in m
+               for m in msgs), msgs
+    assert any("[psum_scatter, all_gather] vs [psum]" in m
                for m in msgs), msgs
     assert all(f.rule == "GL006" and f.hint for f in found)
 

@@ -22,11 +22,15 @@ from tools.graftlint.astutil import (dotted, enclosing_functions,
                                      module_str_constants, param_default)
 from tools.graftlint.core import Checker, Finding, ParsedFile, Project
 
-# collective -> positional index of its axis-name argument
+# collective -> positional index of its axis-name argument.
+# reduce_scatter covers external spellings of jax's psum_scatter (the
+# XLA/paper name for the same op); kept in sync with graftsan's
+# KNOWN_COLLECTIVES (core/sanitizer.py) so runtime-recorded kinds and
+# statically-checked kinds never drift.
 COLLECTIVES: Dict[str, int] = {
     "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "all_gather": 1,
     "all_to_all": 1, "ppermute": 1, "pshuffle": 1, "psum_scatter": 1,
-    "axis_index": 0, "pbroadcast": 1, "pcast": 1,
+    "reduce_scatter": 1, "axis_index": 0, "pbroadcast": 1, "pcast": 1,
 }
 
 _PSPEC_NAMES = ("jax.sharding.PartitionSpec",
