@@ -225,5 +225,26 @@ def main():
     }))
 
 
+def serving_sustained_main():
+    """``python bench.py --serving-sustained``: the serving-path row —
+    64 keep-alive clients for a fixed duration against the generic
+    transform arm and the binned bucket-padded data plane, one JSON
+    row per arm plus the QPS-ratio summary (tools/bench_serving.py
+    emit_sustained). BENCH_SERVING_CLIENTS / BENCH_SERVING_DURATION_S
+    override the load shape for rehearsals."""
+    platform = wait_for_backend(metric="serving_sustained", unit="qps",
+                                allow_cpu_fallback=True)
+    print(f"# backend up: {platform}", file=sys.stderr, flush=True)
+    from mmlspark_tpu.core.compile_cache import enable_persistent_cache
+    enable_persistent_cache()
+    from tools.bench_serving import emit_sustained
+    emit_sustained(
+        clients=int(os.environ.get("BENCH_SERVING_CLIENTS", 64)),
+        duration_s=float(os.environ.get("BENCH_SERVING_DURATION_S", 10)))
+
+
 if __name__ == "__main__":
-    main()
+    if "--serving-sustained" in sys.argv:
+        serving_sustained_main()
+    else:
+        main()

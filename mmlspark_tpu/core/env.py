@@ -136,6 +136,27 @@ GROW_POLICY = register(
     "MMLSPARK_TPU_GROW_POLICY", "str", "depthwise",
     "tree growth policy: depthwise|leafwise; leafwise drives splits by "
     "a max-gain priority queue capped by num_leaves")
+SERVE_BINNED = register(
+    "MMLSPARK_TPU_SERVE_BINNED", "str", "auto",
+    "serving binned data plane: auto|off|on — pre-bin request rows to "
+    "the binned ingest dtype on the request threads and score through "
+    "predict_binned_jit at bucket-padded shapes; auto activates when "
+    "the served model supports it, on warns once (reason in /healthz) "
+    "when it cannot, off keeps the generic transform path")
+SERVE_BUCKETS = register(
+    "MMLSPARK_TPU_SERVE_BUCKETS", "str", "",
+    "comma-separated batch-size bucket ladder for the serving data "
+    "plane (the padded compile shapes, pre-warmed at start); empty = "
+    "powers of two up to max_batch_size")
+SERVE_MODEL_QUEUE = register(
+    "MMLSPARK_TPU_SERVE_MODEL_QUEUE", "int", 0,
+    "per-model pending-queue cap in a multi-model ServingServer "
+    "(0 = the server-wide max_queue applies to each model)")
+SERVE_WARM_MODELS = register(
+    "MMLSPARK_TPU_SERVE_WARM_MODELS", "int", 4,
+    "how many served models keep compiled scorers resident (LRU); a "
+    "model evicted cold drops its compiled plane + jit cache and "
+    "rebuilds lazily on next use")
 BENCH_PROBE_TIMEOUT_S = register(
     "MMLSPARK_TPU_BENCH_PROBE_TIMEOUT_S", "int", 90,
     "bench.py: seconds per TPU backend probe attempt")

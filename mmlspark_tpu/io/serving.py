@@ -22,6 +22,30 @@ HTTPSourceStateHolder (HTTPSourceV2.scala:343) is a local dict of
 request-id -> Event; client-supplied ``"id"`` fields are echoed back,
 unless the served model consumes a column literally named 'id', in
 which case only the reserved ``"__id__"`` key is stripped and echoed.
+
+The scoring data plane is compiled and shape-stable: when the served
+model exposes a :meth:`serving_binned_plan` (GBDT models with a
+persisted or derivable binning), request threads pre-bin rows to the
+binned ingest dtype (uint8 for <=256 bins — 8x fewer bytes than the
+float64 generic path, the same low-precision-movement principle as the
+quantized histograms, arXiv:2011.02022) and the batch thread scores
+them through ``predict_binned_jit`` at bucket-padded shapes: each
+drained batch pads up to a power-of-two ladder capped at
+``max_batch_size``, so XLA compiles at most ladder-size graphs no
+matter how batch sizes vary (the dynamic-batching amortization of
+arXiv:1605.08695). Every new compile shape is reported to graftsan's
+recompile budget, so a shape leak aborts loudly under
+``MMLSPARK_TPU_SAN=1``. ``MMLSPARK_TPU_SERVE_BINNED=auto|off|on``
+selects the plane; a downgrade warns once and records its reason in
+``/healthz``.
+
+Multi-model: ``ServingServer(models={...})`` serves a named registry
+with per-model bounded queues, routed by path
+(``/models/<name><api_path>``) or payload field (``"__model__"``);
+``GET /models`` lists them, ``GET /models/<name>/healthz`` reports
+per-model stats. Compiled scorers stay resident for the
+``MMLSPARK_TPU_SERVE_WARM_MODELS`` most-recently-scored models (LRU);
+evicted-cold models drop their plane + jit cache and rebuild lazily.
 """
 
 from __future__ import annotations
@@ -29,7 +53,7 @@ from __future__ import annotations
 import json
 import threading
 import time
-import uuid
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
@@ -37,6 +61,14 @@ import numpy as np
 
 from mmlspark_tpu.core import sanitizer
 from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.env import (
+    SERVE_BINNED,
+    SERVE_BUCKETS,
+    SERVE_MODEL_QUEUE,
+    SERVE_WARM_MODELS,
+    env_int,
+    env_str,
+)
 from mmlspark_tpu.core.faults import fault_point
 from mmlspark_tpu.core.logging_utils import logger, warn_once
 from mmlspark_tpu.core.pipeline import Transformer
@@ -93,40 +125,179 @@ class _CappedThreadingHTTPServer(ThreadingHTTPServer):
 
 
 class _Pending:
-    __slots__ = ("payload", "event", "reply", "error")
+    __slots__ = ("payload", "event", "reply", "error", "binned")
 
     def __init__(self, payload):
         self.payload = payload
         self.event = threading.Event()
         self.reply = None
         self.error = None
+        self.binned = None  # pre-binned (F,) row, set on request threads
+
+
+def _bucket_ladder(max_batch_size: int) -> List[int]:
+    """Padded compile shapes for the binned data plane: powers of two
+    capped at (and always containing) ``max_batch_size``, overridable
+    via MMLSPARK_TPU_SERVE_BUCKETS as a comma-separated size list.
+    Small and fixed by construction — the scorer compiles at most
+    ``len(ladder)`` graphs regardless of how request batch sizes vary."""
+    spec = (env_str(SERVE_BUCKETS, "") or "").strip()
+    if spec:
+        try:
+            sizes = sorted({int(tok) for tok in spec.split(",")
+                            if tok.strip()})
+        except ValueError:
+            warn_once(
+                "serving.buckets",
+                "%s=%r is not a comma-separated int list; using the "
+                "power-of-two ladder", SERVE_BUCKETS, spec)
+            sizes = []
+        sizes = [s for s in sizes if 0 < s <= max_batch_size]
+        if sizes:
+            if sizes[-1] != max_batch_size:
+                sizes.append(max_batch_size)
+            return sizes
+    sizes = []
+    b = 1
+    while b < max_batch_size:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_batch_size)
+    return sizes
+
+
+class _BinnedPlane:
+    """Compiled, shape-stable scoring plane for one served model.
+
+    ``bin_row`` runs on request threads (numpy only); ``score_rows``
+    runs on the (single) scoring thread: it pads the batch up to the
+    next bucket (pad rows are all-bin-0, the always-valid missing
+    sentinel), scores ONE compiled graph, and slices the padding off.
+    Per-row scan lanes are independent, so the sliced result is bitwise
+    identical to scoring the exact shape — the parity contract
+    tests/io/test_serving_binned.py pins. Every first-seen compile
+    shape is reported to graftsan's recompile budget."""
+
+    def __init__(self, plan, ladder: List[int]):
+        self.plan = plan
+        self.ladder = list(ladder)
+        self._seen: set = set()
+
+    def bin_row(self, payload: Dict[str, Any]) -> np.ndarray:
+        feats = payload.get(self.plan.features_col)
+        if feats is None:
+            raise KeyError(
+                f"payload lacks {self.plan.features_col!r}")
+        row = np.asarray(feats, dtype=np.float64).reshape(1, -1)
+        return self.plan.bin_rows(row)[0]
+
+    def _bucket(self, n: int) -> int:
+        for b in self.ladder:
+            if b >= n:
+                return b
+        return self.ladder[-1]
+
+    def _mark_shape(self, xb: np.ndarray) -> None:
+        key = (xb.shape, str(xb.dtype))
+        if key not in self._seen:
+            self._seen.add(key)
+            sanitizer.count_recompile(
+                f"serving.binned_scorer shape={key[0]} dtype={key[1]}")
+
+    def score_rows(self, rows: List[np.ndarray]) -> Dict[str, np.ndarray]:
+        n = len(rows)
+        xb = np.zeros((self._bucket(n), self.plan.num_features),
+                      dtype=self.plan.ingest_dtype)
+        xb[:n] = np.stack(rows)
+        self._mark_shape(xb)
+        raw = np.asarray(self.plan.score(xb))[:n]
+        return self.plan.finish(raw)
+
+    def warmup(self) -> None:
+        """Compile every ladder shape before the first request (bin 0
+        is always a valid input, so no payload is needed)."""
+        for b in self.ladder:
+            xb = np.zeros((b, self.plan.num_features),
+                          dtype=self.plan.ingest_dtype)
+            self._mark_shape(xb)
+            np.asarray(self.plan.score(xb))
+
+
+class _ServedModel:
+    """One registered model: its bounded queue, stats, and (while warm)
+    compiled binned plane."""
+
+    def __init__(self, name: str, model: Transformer, max_queue: int,
+                 keep_id: bool):
+        self.name = name
+        self.model = model
+        self.max_queue = max_queue
+        self.keep_id = keep_id
+        self.queue: List[_Pending] = []
+        self.stats = {"served": 0, "errors": 0, "rejected": 0,
+                      "timeouts": 0, "binned_batches": 0,
+                      "generic_batches": 0, "binned_fallbacks": 0,
+                      "cold_rebuilds": 0, "evictions": 0}
+        self.plane: Optional[_BinnedPlane] = None
+        self.binned_mode = "off"            # resolved at start()
+        self.binned_supported: Optional[bool] = None  # None = untried
+        self.binned_reason: Optional[str] = None
 
 
 class ServingServer:
-    """Serve a fitted Transformer over HTTP with micro-batched scoring."""
+    """Serve fitted Transformers over HTTP with micro-batched scoring.
 
-    def __init__(self, model: Transformer, host: str = "127.0.0.1",
+    Single-model (``ServingServer(model)``) keeps the original surface;
+    ``ServingServer(models={"a": m_a, "b": m_b})`` serves a named
+    registry (see the module docstring for routing and the compiled
+    data plane)."""
+
+    def __init__(self, model: Optional[Transformer] = None,
+                 host: str = "127.0.0.1",
                  port: int = 0, reply_col: Optional[str] = None,
                  max_batch_size: int = 64, max_latency_ms: float = 5.0,
                  api_path: str = "/score", max_queue: int = 256,
                  request_timeout_s: float = 30.0,
                  max_connections: int = 64,
                  idle_timeout_s: float = 15.0,
-                 retry_after_s: float = 1.0):
-        self.model = model
-        self._keep_id = self._consumes_id_column(model)
+                 retry_after_s: float = 1.0,
+                 models: Optional[Dict[str, Transformer]] = None,
+                 default_model: Optional[str] = None,
+                 warmup_payload: Optional[dict] = None):
+        if (model is None) == (models is None):
+            raise ValueError("pass exactly one of model= or models=")
+        if models is None:
+            models = {default_model or "default": model}
+        for name in models:
+            if "/" in name or not name:
+                raise ValueError(f"invalid model name {name!r}")
+        self._default = default_model or next(iter(models))
+        if self._default not in models:
+            raise ValueError(f"default_model {self._default!r} not in "
+                             f"models {sorted(models)}")
+        self.model = models[self._default]
         self.reply_col = reply_col
         self.max_batch_size = max_batch_size
         self.max_latency_ms = max_latency_ms
         self.api_path = api_path
-        # backpressure contract: the pending queue is BOUNDED; a full
+        # backpressure contract: every pending queue is BOUNDED; a full
         # queue answers 503 + Retry-After instead of queueing without
         # limit (an overloaded scorer would otherwise accumulate
         # requests it can never answer within their deadline)
         self.max_queue = max_queue
         self.request_timeout_s = request_timeout_s
         self.retry_after_s = retry_after_s
-        self._queue: List[_Pending] = []
+        self._warmup_payload = warmup_payload
+        per_model_queue = env_int(SERVE_MODEL_QUEUE, 0, minimum=0)
+        self._models: Dict[str, _ServedModel] = {
+            name: _ServedModel(name, m, per_model_queue or max_queue,
+                               self._consumes_id_column(m))
+            for name, m in models.items()}
+        self._model_names = list(self._models)
+        self._rr = 0                     # round-robin cursor (batch loop)
+        self._warm: "OrderedDict[str, None]" = OrderedDict()
+        self._warm_capacity = env_int(SERVE_WARM_MODELS, 4, minimum=1)
+        self._ladder: List[int] = _bucket_ladder(max_batch_size)
         self._lock = threading.Condition()
         self._stop = False
         self._stats = {"served": 0, "errors": 0, "rejected": 0,
@@ -167,10 +338,21 @@ class ServingServer:
                 if self.path == "/healthz":
                     self._reply_json(200, server._health())
                     return
+                if self.path == "/models":
+                    self._reply_json(200, server._models_listing())
+                    return
+                if (self.path.startswith("/models/")
+                        and self.path.endswith("/healthz")):
+                    name = self.path[len("/models/"):-len("/healthz")]
+                    served = server._models.get(name)
+                    if served is not None:
+                        self._reply_json(200, server._model_health(served))
+                        return
                 self.send_error(404)
 
             def do_POST(self):
-                if self.path != server.api_path:
+                served = server._route_post(self.path)
+                if served is None:
                     self.send_error(404)
                     return
                 if "chunked" in (self.headers.get(
@@ -185,8 +367,24 @@ class ServingServer:
                 except json.JSONDecodeError as e:
                     self.send_error(400, f"bad json: {e}")
                     return
+                route = payload.pop("__model__", None) \
+                    if isinstance(payload, dict) else None
+                if route is not None:
+                    served = server._models.get(route)
+                    if served is None:
+                        self.send_error(404, f"unknown model {route!r}")
+                        return
                 pending = _Pending(payload)
-                if not server._enqueue(pending):
+                plane = served.plane
+                if plane is not None:
+                    # pre-bin on the request thread: the scoring thread
+                    # receives uint8 rows, not raw dicts (a bad row
+                    # falls back to the generic path for its batch)
+                    try:
+                        pending.binned = plane.bin_row(payload)
+                    except Exception:
+                        pending.binned = None
+                if not server._enqueue(pending, served):
                     # backpressure: bounded queue is full — shed load
                     # NOW with a retry hint instead of queueing past
                     # any deadline the client could still meet
@@ -199,10 +397,11 @@ class ServingServer:
                         timeout=server.request_timeout_s):
                     with server._lock:
                         server._stats["timeouts"] += 1
+                        served.stats["timeouts"] += 1
                         # a timed-out request still sitting in the
                         # queue must not consume a scoring slot
-                        if pending in server._queue:
-                            server._queue.remove(pending)
+                        if pending in served.queue:
+                            served.queue.remove(pending)
                     self.send_error(504, "scoring timed out")
                     return
                 if pending.error is not None:
@@ -224,48 +423,177 @@ class ServingServer:
         self._batch_thread = threading.Thread(
             target=self._batch_loop, daemon=True)
 
-    def _enqueue(self, pending: "_Pending") -> bool:
+    # -- routing -------------------------------------------------------------
+    def _route_post(self, path: str) -> Optional[_ServedModel]:
+        if path == self.api_path:
+            return self._models[self._default]
+        if path.startswith("/models/"):
+            name, _, sub = path[len("/models/"):].partition("/")
+            served = self._models.get(name)
+            if served is not None and ("/" + sub) == self.api_path:
+                return served
+        return None
+
+    def _enqueue(self, pending: "_Pending", served: _ServedModel) -> bool:
         with self._lock:
-            if len(self._queue) >= self.max_queue:
+            if len(served.queue) >= served.max_queue:
                 self._stats["rejected"] += 1
+                served.stats["rejected"] += 1
                 self._last_shed = time.monotonic()
                 warn_once(
                     "serving.backpressure",
                     "serving queue full (max_queue=%s); shedding load "
-                    "with 503 + Retry-After", self.max_queue)
+                    "with 503 + Retry-After", served.max_queue)
                 return False
-            self._queue.append(pending)
+            served.queue.append(pending)
             self._lock.notify()
             return True
 
+    # -- health --------------------------------------------------------------
+    def _model_health(self, served: _ServedModel) -> Dict[str, Any]:
+        with self._lock:
+            return {"name": served.name, "queueDepth": len(served.queue),
+                    "maxQueue": served.max_queue,
+                    "warm": served.name in self._warm,
+                    "binned": {"mode": served.binned_mode,
+                               "active": served.plane is not None,
+                               "reason": served.binned_reason},
+                    **served.stats}
+
+    def _models_listing(self) -> Dict[str, Any]:
+        return {"default": self._default,
+                "models": {name: self._model_health(m)
+                           for name, m in self._models.items()}}
+
     def _health(self) -> Dict[str, Any]:
-        """/healthz payload: ``degraded`` while the pending queue sits
+        """/healthz payload: ``degraded`` while the pending queues sit
         at half capacity or load was shed in the last 5 s — scrapers
         and fleet registries can steer traffic away before hard 503s
         dominate, and the flag clears once the backlog drains."""
         with self._lock:
-            depth = len(self._queue)
+            depth = sum(len(m.queue) for m in self._models.values())
             stats = dict(self._stats)
             last_shed = self._last_shed
+            default = self._models[self._default]
+            binned = {"mode": default.binned_mode,
+                      "active": default.plane is not None,
+                      "reason": default.binned_reason}
         degraded = (depth >= max(self.max_queue // 2, 1)
                     or (last_shed and time.monotonic() - last_shed < 5.0))
-        return {"status": "degraded" if degraded else "ok",
-                "queueDepth": depth, "maxQueue": self.max_queue,
-                "rejectedConnections": getattr(
-                    self._httpd, "rejected_connections", 0), **stats}
+        health = {"status": "degraded" if degraded else "ok",
+                  "queueDepth": depth, "maxQueue": self.max_queue,
+                  "rejectedConnections": getattr(
+                      self._httpd, "rejected_connections", 0), **stats,
+                  "binned": binned, "buckets": list(self._ladder)}
+        if len(self._models) > 1:
+            health["models"] = {name: self._model_health(m)
+                                for name, m in self._models.items()}
+        return health
+
+    # -- binned plane / warm-set management ----------------------------------
+    def _ensure_plane(self, served: _ServedModel) -> None:
+        """Build (or rebuild) the compiled binned plane for a model and
+        warm every ladder shape; on failure, record the downgrade
+        reason (surfaced in /healthz) and — under SERVE_BINNED=on —
+        warn once."""
+        if (served.binned_mode == "off" or served.plane is not None
+                or served.binned_supported is False):
+            return
+        plan_fn = getattr(served.model, "serving_binned_plan", None)
+        if plan_fn is None:
+            served.binned_supported = False
+            served.binned_reason = ("model exposes no "
+                                    "serving_binned_plan (generic "
+                                    "Transformer)")
+        else:
+            try:
+                plane = _BinnedPlane(plan_fn(), self._ladder)
+                plane.warmup()
+                served.plane = plane
+                served.binned_supported = True
+                served.binned_reason = None
+                return
+            except Exception as e:
+                served.binned_supported = False
+                served.binned_reason = str(e)
+        if served.binned_mode == "on":
+            warn_once(
+                f"serving.binned_downgrade.{served.name}",
+                "MMLSPARK_TPU_SERVE_BINNED=on but model %r cannot use "
+                "the binned data plane (%s); using the generic "
+                "transform path", served.name, served.binned_reason)
+
+    def _touch_warm(self, served: _ServedModel) -> None:
+        """LRU warm-set bookkeeping at scoring time: the scored model
+        becomes most-recent; beyond capacity, the coldest model drops
+        its compiled plane and jit cache (rebuilt lazily on next use)."""
+        if served.name in self._warm:
+            self._warm.move_to_end(served.name)
+            return
+        self._warm[served.name] = None
+        if served.plane is None:
+            # first touch of a model that was cold at start builds its
+            # plane now; a previously-built one rebuilds (counted)
+            rebuilt = served.binned_supported is True
+            self._ensure_plane(served)
+            if rebuilt and served.plane is not None:
+                served.stats["cold_rebuilds"] += 1
+        while len(self._warm) > self._warm_capacity:
+            cold_name, _ = self._warm.popitem(last=False)
+            cold = self._models[cold_name]
+            cold.plane = None
+            booster = getattr(cold.model, "booster", None)
+            if booster is not None and hasattr(booster, "clear_jit_cache"):
+                booster.clear_jit_cache()
+            cold.stats["evictions"] += 1
+
+    def _warm_start(self) -> None:
+        """Resolve the binned mode, build + pre-warm every bucket shape
+        for the (up to ``MMLSPARK_TPU_SERVE_WARM_MODELS``) first
+        models, and — when a ``warmup_payload`` was given — compile the
+        generic transform graph for warm models without a plane, so the
+        first request never pays compile latency."""
+        mode = (env_str(SERVE_BINNED, "auto") or "auto").strip().lower()
+        if mode not in ("auto", "off", "on"):
+            warn_once("serving.binned_mode",
+                      "%s=%r is not auto|off|on; using auto",
+                      SERVE_BINNED, mode)
+            mode = "auto"
+        for served in self._models.values():
+            served.binned_mode = mode
+            if mode == "off":
+                served.binned_reason = \
+                    "disabled (MMLSPARK_TPU_SERVE_BINNED=off)"
+        for served in list(self._models.values())[:self._warm_capacity]:
+            self._warm[served.name] = None
+            self._ensure_plane(served)
+            if served.plane is None and self._warmup_payload is not None:
+                for b in sorted({1, self.max_batch_size}):
+                    self._score([_Pending(dict(self._warmup_payload))
+                                 for _ in range(b)], served)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ServingServer":
+        self._warm_start()
         self._server_thread.start()
         self._batch_thread.start()
-        logger.info("serving on %s:%s%s", self.host, self.port,
-                    self.api_path)
+        logger.info("serving on %s:%s%s (%d model(s))", self.host,
+                    self.port, self.api_path, len(self._models))
         return self
 
     def stop(self) -> None:
         self._stop = True
         with self._lock:
-            self._lock.notify()
+            flush: List[_Pending] = []
+            for m in self._models.values():
+                flush.extend(m.queue)
+                m.queue.clear()
+            self._lock.notify_all()
+        for p in flush:
+            # never strand a waiting request thread on shutdown: the
+            # sustained-load contract is "no deadlock on stop"
+            p.error = "server stopped"
+            p.event.set()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -280,27 +608,44 @@ class ServingServer:
         self.stop()
 
     # -- scoring loop --------------------------------------------------------
+    def _next_served(self) -> Optional[_ServedModel]:
+        """Round-robin over models with pending requests (caller holds
+        the lock) — one slow model's queue cannot starve the others'."""
+        n = len(self._model_names)
+        for i in range(n):
+            served = self._models[self._model_names[(self._rr + i) % n]]
+            if served.queue:
+                self._rr = (self._rr + i + 1) % n
+                return served
+        return None
+
     def _batch_loop(self):
         while not self._stop:
             with self._lock:
-                if not self._queue:
+                served = self._next_served()
+                if served is None:
                     self._lock.wait(timeout=0.5)
-                if not self._queue:
+                    served = self._next_served()
+                if served is None:
                     continue
                 deadline = time.monotonic() + self.max_latency_ms / 1000.0
-                while (len(self._queue) < self.max_batch_size
+                while (len(served.queue) < self.max_batch_size
                        and time.monotonic() < deadline):
                     self._lock.wait(timeout=max(
                         deadline - time.monotonic(), 0.0))
-                batch = self._queue[:self.max_batch_size]
-                del self._queue[:len(batch)]
+                batch = served.queue[:self.max_batch_size]
+                del served.queue[:len(batch)]
+            if not batch:  # all requests timed out during the wait
+                continue
             try:
-                self._score(batch)
+                self._score(batch, served)
                 with self._lock:
                     self._stats["served"] += len(batch)
+                    served.stats["served"] += len(batch)
             except Exception as e:  # surface scoring errors to callers
                 with self._lock:
                     self._stats["errors"] += len(batch)
+                    served.stats["errors"] += len(batch)
                 for p in batch:
                     p.error = str(e)
                     p.event.set()
@@ -327,12 +672,15 @@ class ServingServer:
             pass
         return False
 
-    def _score(self, batch: List[_Pending]):
+    def _score(self, batch: List[_Pending],
+               served: Optional[_ServedModel] = None):
         # injection point for the overload/robustness tests: a delay
         # here simulates a slow model (queue backs up -> 503s), a raise
         # simulates a failing one (500s surface to callers)
         fault_point("serving.score")
-        keep_id = self._keep_id
+        if served is None:
+            served = self._models[self._default]
+        keep_id = served.keep_id
         ids = []
         for p in batch:
             rid = p.payload.pop("__id__", None)
@@ -340,18 +688,37 @@ class ServingServer:
                 legacy = p.payload.pop("id", None)
                 rid = rid if rid is not None else legacy
             ids.append(rid)
-        df = DataFrame.from_rows([p.payload for p in batch])
-        out = self.model.transform(df)
-        reply_cols = [self.reply_col] if self.reply_col else \
-            [c for c in out.columns if c not in df.columns] or out.columns
+        self._touch_warm(served)
+        cols: Optional[Dict[str, Any]] = None
+        plane = served.plane
+        if plane is not None and all(p.binned is not None for p in batch):
+            try:
+                cols = plane.score_rows([p.binned for p in batch])
+                if self.reply_col:
+                    cols = {self.reply_col: cols[self.reply_col]}
+            except Exception as e:
+                warn_once(f"serving.binned_score.{served.name}",
+                          "binned scoring failed (%s); batch falls "
+                          "back to the generic transform path", e)
+                cols = None
+        if cols is not None:
+            served.stats["binned_batches"] += 1
+        else:
+            if plane is not None:
+                served.stats["binned_fallbacks"] += 1
+            df = DataFrame.from_rows([p.payload for p in batch])
+            out = served.model.transform(df)
+            reply_cols = [self.reply_col] if self.reply_col else \
+                [c for c in out.columns if c not in df.columns] or out.columns
+            cols = {c: out.col(c) for c in reply_cols}
+            served.stats["generic_batches"] += 1
         # score-path jit-boundary guard: a NaN prediction here would
         # otherwise serialize into a client-visible JSON "NaN"
-        sanitizer.check_finite("serving.score",
-                               {c: out.col(c) for c in reply_cols})
+        sanitizer.check_finite("serving.score", cols)
         for i, p in enumerate(batch):
             reply = {}
-            for c in reply_cols:
-                v = out.col(c)[i]
+            for c, values in cols.items():
+                v = values[i]
                 if isinstance(v, np.ndarray):
                     v = v.tolist()
                 elif isinstance(v, np.generic):
@@ -371,35 +738,27 @@ class ContinuousServingServer(ServingServer):
     :class:`ServingFleet` of these for both.
     """
 
-    def __init__(self, model: Transformer, warmup_payload: Optional[dict] = None,
-                 **kwargs):
+    def __init__(self, model: Optional[Transformer] = None,
+                 warmup_payload: Optional[dict] = None, **kwargs):
         kwargs.setdefault("max_batch_size", 1)
-        super().__init__(model, **kwargs)
+        super().__init__(model, warmup_payload=warmup_payload, **kwargs)
         self._score_lock = threading.Lock()
-        self._warmup_payload = warmup_payload
         # synchronous mode has no queue; the backpressure bound caps
         # how many requests may WAIT on the scorer lock at once
         self._inflight = threading.BoundedSemaphore(max(self.max_queue, 1))
 
     def start(self) -> "ContinuousServingServer":
-        if self._warmup_payload is not None:
-            # compile the batch-1 scoring graph before the first request
-            p = _Pending(dict(self._warmup_payload))
-            self._score([p])
+        self._warm_start()
         self._server_thread.start()  # no batch thread: scoring is inline
         logger.info("continuous serving on %s:%s%s", self.host, self.port,
                     self.api_path)
         return self
 
-    def stop(self) -> None:
-        self._stop = True
-        self._httpd.shutdown()
-        self._httpd.server_close()
-
-    def _enqueue(self, pending: "_Pending") -> bool:
+    def _enqueue(self, pending: "_Pending", served: _ServedModel) -> bool:
         if not self._inflight.acquire(blocking=False):
             with self._lock:
                 self._stats["rejected"] += 1
+                served.stats["rejected"] += 1
                 self._last_shed = time.monotonic()
             warn_once(
                 "serving.backpressure",
@@ -408,12 +767,14 @@ class ContinuousServingServer(ServingServer):
             return False
         try:
             with self._score_lock:
-                self._score([pending])
+                self._score([pending], served)
             with self._lock:
                 self._stats["served"] += 1
+                served.stats["served"] += 1
         except Exception as e:
             with self._lock:
                 self._stats["errors"] += 1
+                served.stats["errors"] += 1
             pending.error = str(e)
             pending.event.set()
         finally:
@@ -430,9 +791,10 @@ class ServingFleet:
     a :class:`ServingServer` (one per host in a pod), and the registry
     is an HTTP endpoint returning every worker's address so clients can
     spray requests — requests enter AT the workers, never proxied.
-    """
+    Pass ``models={...}`` to serve a named registry on every worker."""
 
-    def __init__(self, model: Transformer, num_servers: int = 2,
+    def __init__(self, model: Optional[Transformer] = None,
+                 num_servers: int = 2,
                  continuous: bool = False, host: str = "127.0.0.1",
                  **server_kwargs):
         cls = ContinuousServingServer if continuous else ServingServer
@@ -509,14 +871,23 @@ class FleetClient:
     FaultToleranceUtils.retryWithTimeout,
     core/utils/FaultToleranceUtils.scala:9-31)."""
 
+    # floor between re-discoveries when the worker list has shrunk: a
+    # permanently-dead worker stays listed by the registry, so without
+    # a floor every score() would re-add it and pay a failed attempt
+    _min_refresh_gap_s = 1.0
+
     def __init__(self, registry_url: str, timeout: float = 15.0,
-                 retries_per_worker: int = 1):
+                 retries_per_worker: int = 1,
+                 refresh_interval_s: float = 30.0):
         self.registry_url = registry_url
         self.timeout = timeout
         self.retries_per_worker = retries_per_worker
+        self.refresh_interval_s = refresh_interval_s
         self._workers: List[str] = []
         self._next = 0
         self._lock = threading.Lock()
+        self._registry_count = 0
+        self._last_refresh = 0.0
 
     def refresh(self) -> List[str]:
         import urllib.request
@@ -525,6 +896,8 @@ class FleetClient:
             workers = json.loads(r.read())["workers"]
         with self._lock:
             self._workers = workers
+            self._registry_count = len(workers)
+            self._last_refresh = time.monotonic()
         return list(workers)
 
     def _pick(self) -> Optional[str]:
@@ -535,10 +908,29 @@ class FleetClient:
             self._next += 1
             return url
 
+    def _maybe_refresh(self) -> None:
+        """Re-discover workers when the local list has shrunk below the
+        registry's count (a worker evicted on one transient failure
+        must rejoin rotation without waiting for ANOTHER failure) or on
+        the staleness interval. Refresh failures are non-fatal here —
+        the known worker list still serves."""
+        with self._lock:
+            now = time.monotonic()
+            shrunk = len(self._workers) < self._registry_count
+            stale = now - self._last_refresh > self.refresh_interval_s
+            recent = now - self._last_refresh < self._min_refresh_gap_s
+        if (shrunk or stale) and not recent:
+            try:
+                self.refresh()
+            except Exception:
+                pass
+
     def score(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         import urllib.request
         if not self._workers:
             self.refresh()
+        else:
+            self._maybe_refresh()
         n = max(len(self._workers), 1)
         attempts = max(n * self.retries_per_worker, 1)
         last: Optional[Exception] = None

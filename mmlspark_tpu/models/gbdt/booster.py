@@ -73,6 +73,14 @@ class BoosterArrays:
             cache[name] = jax.jit(maker())
         return cache[name]
 
+    def clear_jit_cache(self) -> None:
+        """Drop the per-instance jitted-scorer cache (the serving
+        warm/cold LRU eviction hook): compiled executables release, and
+        scorers rebuild lazily on next use. The memoized eligibility
+        verdicts (``supports_binned`` / ``zero_premap_mode``) stay —
+        they describe the immutable arrays, not compiled artifacts."""
+        self.__dict__.pop("_fn_cache", None)
+
     def predict_jit(self):
         return self._jitted("predict", self.predict_fn)
 

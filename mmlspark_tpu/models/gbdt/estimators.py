@@ -19,8 +19,8 @@ trainer's histogram reduction is XLA's all-reduce (data_parallel).
 
 from __future__ import annotations
 
-from dataclasses import replace
-from typing import Any, Dict, List, Optional
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -663,6 +663,29 @@ class _LightGBMBase(Estimator, _LightGBMParams):
         return best
 
 
+class BinnedServingUnsupported(RuntimeError):
+    """The model cannot take the binned serving data plane; the message
+    is the downgrade reason the server records in ``/healthz``."""
+
+
+@dataclass
+class ServingBinnedPlan:
+    """Everything the serving data plane needs to score pre-binned rows
+    identically to ``transform`` (``_LightGBMModelBase.
+    serving_binned_plan``). ``bin_rows`` runs on request threads
+    (numpy only, thread-safe); ``score`` is the jitted binned scorer
+    (call on one thread at padded bucket shapes); ``finish`` turns raw
+    margin scores into the same ordered reply columns ``transform``
+    would have appended."""
+
+    bin_rows: Callable[[np.ndarray], np.ndarray]
+    score: Callable[[np.ndarray], Any]
+    finish: Callable[[np.ndarray], Dict[str, np.ndarray]]
+    ingest_dtype: Any
+    num_features: int
+    features_col: str
+
+
 class _LightGBMModelBase(Model, _LightGBMParams):
     """Shared transform/scoring (LightGBMModelMethods analog)."""
 
@@ -812,6 +835,90 @@ class _LightGBMModelBase(Model, _LightGBMParams):
                                 contribs.astype(np.float64))
         return df
 
+    def _reply_columns_from_raw(self, raw: np.ndarray) -> Dict[str, Any]:
+        """Ordered output columns derived from margin scores — the
+        shared tail of ``_transform``, factored out so the serving
+        binned data plane reproduces transform's reply bitwise from
+        ``predict_binned_jit`` raw scores (binned routing is pinned
+        bitwise-identical to raw routing, tests/gbdt/
+        test_binned_scoring; per-row lanes are independent, so bucket
+        padding + slicing preserves that)."""
+        raise NotImplementedError
+
+    def serving_binned_plan(self) -> ServingBinnedPlan:
+        """Build the compiled serving data plane for this model, or
+        raise :class:`BinnedServingUnsupported` with the reason.
+
+        Trained models (``bin_mapper`` persisted) bin through the
+        training BinMapper with the booster's ``zero_premap_mode``
+        applied; imported model strings (raw thresholds only) recover a
+        binning from their own splits via ``derive_binning``. Either
+        way rows move at the narrowest ingest dtype (uint8 for <=256
+        bins) and route bitwise-identically to ``transform``."""
+        from mmlspark_tpu.ops.ingest import binned_ingest_dtype
+        if self.booster is None:
+            raise BinnedServingUnsupported("model has no fitted booster")
+        if self._mesh is not None:
+            raise BinnedServingUnsupported(
+                "mesh-sharded scoring (set_mesh) is not wired into the "
+                "binned serving plane")
+        if self.is_set("leafPredictionCol") or self.is_set("featuresShapCol"):
+            raise BinnedServingUnsupported(
+                "leafPredictionCol/featuresShapCol require raw features")
+        b = self.scoring_booster
+        features_col = self.get("featuresCol")
+        expected_f = self.booster.num_features
+        check_shape = not self.get("predictDisableShapeCheck")
+
+        def _check(x: np.ndarray) -> np.ndarray:
+            x = np.asarray(x, dtype=np.float64)
+            if check_shape and x.shape[1] != expected_f:
+                raise ValueError(
+                    f"feature count mismatch: model has {expected_f},"
+                    f" data has {x.shape[1]}")
+            return x
+
+        if self.bin_mapper is not None:
+            if not b.supports_binned:
+                raise BinnedServingUnsupported(
+                    "booster does not support binned routing "
+                    "(categorical splits or missing bin thresholds)")
+            zmode = b.zero_premap_mode
+            if zmode == "unsupported":
+                raise BinnedServingUnsupported(
+                    "mixed per-node zero-as-missing semantics cannot be "
+                    "expressed as per-feature bin ids")
+            mapper = self.bin_mapper
+            dtype = binned_ingest_dtype(mapper.max_num_bins)
+
+            def bin_rows(x: np.ndarray) -> np.ndarray:
+                x = _check(x)
+                if zmode == "all_left":
+                    # zero_as_missing fit mapped 0.0 -> NaN before
+                    # binning; scoring must bin through the same premap
+                    x = np.where(x == 0.0, np.nan, x)
+                return mapper.transform(x).astype(dtype)
+
+            score = b.predict_binned_jit()
+        else:
+            try:
+                binning, derived = b.derive_binning()
+            except Exception as e:
+                raise BinnedServingUnsupported(
+                    f"derive_binning failed: {e}") from e
+            dtype = binning.dtype
+
+            def bin_rows(x: np.ndarray) -> np.ndarray:
+                return binning.transform(_check(x))
+
+            score = derived.predict_binned_jit()
+
+        return ServingBinnedPlan(
+            bin_rows=bin_rows, score=score,
+            finish=self._reply_columns_from_raw,
+            ingest_dtype=dtype, num_features=expected_f,
+            features_col=features_col)
+
 
 # ---------------------------------------------------------------------------
 # Classifier
@@ -914,11 +1021,9 @@ class LightGBMClassificationModel(_LightGBMModelBase):
         c = state.get("classes_")
         self.classes_ = None if c is None else np.asarray(c)
 
-    def _transform(self, df: DataFrame) -> DataFrame:
+    def _reply_columns_from_raw(self, raw: np.ndarray) -> Dict[str, Any]:
         import jax.numpy as jnp
 
-        x = self._features(df)
-        raw = self._raw_scores(x)
         if raw.ndim == 1:  # binary: margins for [neg, pos]
             raw2 = np.stack([-raw, raw], axis=1)
             prob = 1.0 / (1.0 + np.exp(-raw))
@@ -937,9 +1042,16 @@ class LightGBMClassificationModel(_LightGBMModelBase):
             pred = self.classes_[pred_idx].astype(np.float64)
         else:
             pred = pred_idx.astype(np.float64)
-        out = (df.with_column(self.get("rawPredictionCol"), raw2)
-                 .with_column(self.get("probabilityCol"), probs)
-                 .with_column(self.get("predictionCol"), pred))
+        return {self.get("rawPredictionCol"): raw2,
+                self.get("probabilityCol"): probs,
+                self.get("predictionCol"): pred}
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        x = self._features(df)
+        out = df
+        for name, vals in self._reply_columns_from_raw(
+                self._raw_scores(x)).items():
+            out = out.with_column(name, vals)
         return self._maybe_extra_cols(out, x)
 
 
@@ -975,12 +1087,17 @@ class LightGBMRegressor(_LightGBMBase):
 
 
 class LightGBMRegressionModel(_LightGBMModelBase):
-    def _transform(self, df: DataFrame) -> DataFrame:
-        x = self._features(df)
-        raw = self._raw_scores(x)
+    def _reply_columns_from_raw(self, raw: np.ndarray) -> Dict[str, Any]:
         if self.booster.objective in ("poisson", "gamma", "tweedie"):
             raw = np.exp(raw)
-        out = df.with_column(self.get("predictionCol"), raw.astype(np.float64))
+        return {self.get("predictionCol"): raw.astype(np.float64)}
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        x = self._features(df)
+        out = df
+        for name, vals in self._reply_columns_from_raw(
+                self._raw_scores(x)).items():
+            out = out.with_column(name, vals)
         return self._maybe_extra_cols(out, x)
 
 
@@ -1025,10 +1142,15 @@ class LightGBMRanker(_LightGBMBase):
 
 
 class LightGBMRankerModel(_LightGBMModelBase):
+    def _reply_columns_from_raw(self, raw: np.ndarray) -> Dict[str, Any]:
+        return {self.get("predictionCol"): raw.astype(np.float64)}
+
     def _transform(self, df: DataFrame) -> DataFrame:
         x = self._features(df)
-        raw = self._raw_scores(x)
-        out = df.with_column(self.get("predictionCol"), raw.astype(np.float64))
+        out = df
+        for name, vals in self._reply_columns_from_raw(
+                self._raw_scores(x)).items():
+            out = out.with_column(name, vals)
         return self._maybe_extra_cols(out, x)
 
 

@@ -1,0 +1,217 @@
+"""Serving-level contract of the binned data plane: bitwise parity
+with the generic transform path (including exact-0.0 handling per
+``booster.zero_premap_mode``), the /healthz downgrade reason, and the
+bucket-ladder recompile budget under graftsan."""
+
+import json
+import threading
+import urllib.request as urllib_request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import sanitizer
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.env import SERVE_BINNED, env_override
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.io.serving import ServingServer, _Pending
+from mmlspark_tpu.models.gbdt.estimators import LightGBMClassifier
+
+pytestmark = pytest.mark.serving_smoke
+
+N, F = 3000, 28  # HIGGS-shaped feature count, small-N for CI speed
+
+
+def _make_data(rng, zeros=False):
+    x = rng.normal(size=(N, F))
+    if zeros:
+        # plant exact 0.0s so zero-as-missing routing actually fires
+        x[rng.random(size=x.shape) < 0.2] = 0.0
+    y = (x[:, 0] - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
+         + rng.normal(size=N) * 0.5 > 0).astype(np.float64)
+    return x, y
+
+
+@pytest.fixture(scope="module")
+def higgs_model():
+    rng = np.random.default_rng(7)
+    x, y = _make_data(rng)
+    model = LightGBMClassifier(numIterations=15, numLeaves=15,
+                               maxBin=63).fit(
+        DataFrame({"features": x, "label": y}))
+    return model, x
+
+
+@pytest.fixture(scope="module")
+def zero_missing_model():
+    rng = np.random.default_rng(11)
+    x, y = _make_data(rng, zeros=True)
+    model = LightGBMClassifier(numIterations=15, numLeaves=15, maxBin=63,
+                               zeroAsMissing=True).fit(
+        DataFrame({"features": x, "label": y}))
+    return model, x
+
+
+def _post(url, payload, timeout=30):
+    req = urllib_request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib_request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(url, timeout=10):
+    with urllib_request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _score_rows(server, rows, threads=8):
+    """Concurrent single-row POSTs (id-correlated) -> replies by row."""
+    replies = [None] * len(rows)
+    errors = []
+
+    def worker(idx):
+        try:
+            replies[idx] = _post(server.url, {
+                "features": rows[idx].tolist(), "__id__": idx})
+        except Exception as e:  # pragma: no cover - fail the test below
+            errors.append((idx, e))
+
+    pending = list(range(len(rows)))
+    while pending:
+        chunk, pending = pending[:threads], pending[threads:]
+        ts = [threading.Thread(target=worker, args=(i,)) for i in chunk]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+    assert not errors, errors
+    return replies
+
+
+def _assert_bitwise_parity(model, rows, replies):
+    expected = model.transform(DataFrame({"features": rows}))
+    raw = expected.col("rawPrediction")
+    prob = expected.col("probability")
+    pred = expected.col("prediction")
+    for i, reply in enumerate(replies):
+        assert reply["id"] == i
+        # == on floats IS the bitwise contract (json round-trips the
+        # repr of a float64 exactly)
+        assert reply["prediction"] == float(pred[i])
+        assert reply["rawPrediction"] == [float(v) for v in raw[i]]
+        assert reply["probability"] == [float(v) for v in prob[i]]
+
+
+def test_binned_serving_bitwise_parity(higgs_model):
+    model, x = higgs_model
+    rows = x[:48]
+    with env_override(SERVE_BINNED, "on"):
+        with ServingServer(model, max_batch_size=8,
+                           max_latency_ms=2.0) as server:
+            health = _get(f"http://{server.host}:{server.port}/healthz")
+            assert health["binned"] == {"mode": "on", "active": True,
+                                        "reason": None}
+            assert health["buckets"] == [1, 2, 4, 8]
+            replies = _score_rows(server, rows)
+    _assert_bitwise_parity(model, rows, replies)
+
+
+def test_generic_off_mode_matches_transform_too(higgs_model):
+    """The off arm (the pre-change comparator) must stay the plain
+    transform path and agree with it exactly."""
+    model, x = higgs_model
+    rows = x[:16]
+    with env_override(SERVE_BINNED, "off"):
+        with ServingServer(model, max_batch_size=4,
+                           max_latency_ms=2.0) as server:
+            health = _get(f"http://{server.host}:{server.port}/healthz")
+            assert health["binned"]["active"] is False
+            assert "off" in health["binned"]["reason"]
+            replies = _score_rows(server, rows)
+    _assert_bitwise_parity(model, rows, replies)
+
+
+def test_exact_zero_premap_parity(zero_missing_model):
+    """zeroAsMissing models stamp all_left zero routing; serving must
+    apply the same 0.0 -> NaN premap before binning that fit did."""
+    model, x = zero_missing_model
+    assert model.booster.zero_premap_mode == "all_left"
+    rows = x[:32]
+    assert (rows == 0.0).any()  # the premap actually exercises
+    with env_override(SERVE_BINNED, "on"):
+        with ServingServer(model, max_batch_size=8,
+                           max_latency_ms=2.0) as server:
+            health = _get(f"http://{server.host}:{server.port}/healthz")
+            assert health["binned"]["active"] is True
+            replies = _score_rows(server, rows)
+    _assert_bitwise_parity(model, rows, replies)
+
+
+class _DoubleModel(Transformer):
+    def _transform(self, df):
+        return df.with_column(
+            "out", np.asarray(df.col("value"), np.float64) * 2)
+
+
+def test_on_mode_downgrades_with_reason_for_generic_transformer():
+    with env_override(SERVE_BINNED, "on"):
+        with ServingServer(_DoubleModel(), max_batch_size=4,
+                           max_latency_ms=2.0) as server:
+            health = _get(f"http://{server.host}:{server.port}/healthz")
+            assert health["binned"]["active"] is False
+            assert "serving_binned_plan" in health["binned"]["reason"]
+            # the generic path still serves
+            assert _post(server.url, {"value": 3.0})["out"] == 6.0
+
+
+def test_bucket_ladder_holds_recompile_budget(higgs_model):
+    """1k requests at varying batch sizes compile at most ladder-size
+    scorer graphs; with the graftsan budget armed at exactly that, a
+    shape leak raises RecompileBudgetExceeded (proven by forcing an
+    off-ladder shape at the end)."""
+    model, x = higgs_model
+    sanitizer.reset()
+    sanitizer.enable()
+    try:
+        with env_override(SERVE_BINNED, "on"):
+            server = ServingServer(model, max_batch_size=32,
+                                   max_latency_ms=1.0).start()
+        try:
+            served = server._models["default"]
+            plane = served.plane
+            assert plane is not None
+            ladder = server._ladder
+            assert ladder == [1, 2, 4, 8, 16, 32]
+            warm_compiles = sanitizer.recompile_count()
+            assert warm_compiles <= len(ladder)
+            sanitizer.set_recompile_budget(len(ladder))
+
+            rng = np.random.default_rng(3)
+            total = 0
+            size = 0
+            while total < 1000:
+                b = (size % 32) + 1  # every batch size 1..32, cycling
+                size += 1
+                rows = x[rng.integers(0, len(x), size=b)]
+                batch = []
+                for row in rows:
+                    p = _Pending({"features": row.tolist()})
+                    p.binned = plane.bin_row(p.payload)
+                    batch.append(p)
+                server._score(batch, served)
+                assert all(q.reply is not None for q in batch)
+                total += b
+            assert served.stats["binned_batches"] > 0
+            assert served.stats["generic_batches"] == 0
+            # the whole run held the warm-time compile count
+            assert sanitizer.recompile_count() == warm_compiles
+            # negative control: an off-ladder shape must abort loudly
+            with pytest.raises(sanitizer.RecompileBudgetExceeded):
+                plane._mark_shape(np.zeros((99, F), np.uint8))
+        finally:
+            server.stop()
+    finally:
+        sanitizer.set_recompile_budget(0)
+        sanitizer.disable()
+        sanitizer.reset()

@@ -1,0 +1,202 @@
+"""Multi-model serving registry: routing (path + payload field),
+per-model queues and health, warm/cold LRU eviction of compiled
+scorers, FleetClient worker re-admission, and a sustained-load smoke
+(503s counted, no deadlock on stop)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request as urllib_request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.env import (
+    SERVE_BINNED,
+    SERVE_WARM_MODELS,
+    env_override,
+)
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.io.serving import FleetClient, ServingFleet, ServingServer
+from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+
+pytestmark = pytest.mark.serving_smoke
+
+
+class _ScaleModel(Transformer):
+    def __init__(self, k):
+        super().__init__()
+        self._k = k
+
+    def _transform(self, df):
+        return df.with_column(
+            "out", np.asarray(df.col("value"), np.float64) * self._k)
+
+
+def _post(url, payload, timeout=30):
+    req = urllib_request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib_request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(url, timeout=10):
+    with urllib_request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_multi_model_routing_path_payload_and_default():
+    models = {"double": _ScaleModel(2.0), "triple": _ScaleModel(3.0)}
+    with ServingServer(models=models, max_batch_size=4,
+                       max_latency_ms=2.0) as server:
+        base = f"http://{server.host}:{server.port}"
+        # default route = first registered model
+        assert _post(server.url, {"value": 5.0})["out"] == 10.0
+        # path routing
+        assert _post(f"{base}/models/triple/score",
+                     {"value": 5.0})["out"] == 15.0
+        # payload-field routing wins over the path default
+        assert _post(server.url, {"value": 5.0,
+                                  "__model__": "triple"})["out"] == 15.0
+        # unknown names 404 both ways
+        for bad in (f"{base}/models/nope/score", None):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                if bad:
+                    _post(bad, {"value": 1.0})
+                else:
+                    _post(server.url, {"value": 1.0, "__model__": "nope"})
+            assert err.value.code == 404
+        # /models listing + per-model healthz
+        listing = _get(f"{base}/models")
+        assert listing["default"] == "double"
+        assert set(listing["models"]) == {"double", "triple"}
+        mh = _get(f"{base}/models/triple/healthz")
+        assert mh["served"] >= 2
+        assert mh["binned"]["active"] is False
+        # aggregate health carries the per-model map
+        health = _get(f"{base}/healthz")
+        assert health["served"] >= 3
+        assert set(health["models"]) == {"double", "triple"}
+
+
+@pytest.fixture(scope="module")
+def two_gbdt_models():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(800, 6))
+    models = {}
+    for name, scale in (("a", 1.0), ("b", 10.0)):
+        y = x @ np.arange(1, 7, dtype=np.float64) * scale
+        models[name] = LightGBMRegressor(numIterations=8, numLeaves=7,
+                                         maxBin=31).fit(
+            DataFrame({"features": x, "label": y}))
+    return models, x
+
+
+def test_warm_cold_lru_eviction_rebuilds_scorers(two_gbdt_models):
+    models, x = two_gbdt_models
+    row = {"features": x[0].tolist()}
+    expect = {name: float(m.transform(
+        DataFrame({"features": x[:1]})).col("prediction")[0])
+        for name, m in models.items()}
+    with env_override(SERVE_WARM_MODELS, "1"), \
+            env_override(SERVE_BINNED, "on"):
+        with ServingServer(models=models, max_batch_size=2,
+                           max_latency_ms=1.0) as server:
+            base = f"http://{server.host}:{server.port}"
+            # only one model fits the warm set: scoring b evicts a,
+            # scoring a again rebuilds its compiled plane
+            for name in ("a", "b", "a", "b"):
+                reply = _post(f"{base}/models/{name}/score", dict(row))
+                assert reply["prediction"] == expect[name]
+            health = _get(f"{base}/healthz")
+            stats = health["models"]
+            evictions = sum(m["evictions"] for m in stats.values())
+            rebuilds = sum(m["cold_rebuilds"] for m in stats.values())
+            assert evictions >= 2
+            assert rebuilds >= 2
+            # exactly one model is warm at the end
+            assert sum(m["warm"] for m in stats.values()) == 1
+            assert all(m["binned"]["mode"] == "on" for m in stats.values())
+
+
+def test_fleet_client_readmits_recovered_worker():
+    with ServingFleet(_ScaleModel(2.0), num_servers=3,
+                      max_latency_ms=1.0) as fleet:
+        client = FleetClient(fleet.registry_url, timeout=5.0)
+        client.refresh()
+        assert len(client._workers) == 3
+        # simulate a transient failure: the worker was evicted but is
+        # actually alive — pre-fix, nothing ever re-admitted it
+        with client._lock:
+            evicted = client._workers.pop(0)
+        client._last_refresh -= 5.0  # age past the min refresh gap
+        assert client.score({"value": 4.0})["out"] == 8.0
+        assert evicted in client._workers
+        assert len(client._workers) == 3
+        # staleness interval alone also triggers re-discovery
+        with client._lock:
+            client._workers = list(client._workers)[:2]
+            client._registry_count = 2  # list "complete" but stale
+        client._last_refresh -= 100.0
+        client.refresh_interval_s = 30.0
+        assert client.score({"value": 4.0})["out"] == 8.0
+        assert len(client._workers) == 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def test_sustained_load_smoke_sheds_and_stops_cleanly():
+    """16 concurrent keep-alive clients against a deliberately slowed
+    scorer with a tiny queue: 503s are counted, successful replies are
+    correct, and stop() with requests still in flight neither deadlocks
+    nor strands a client."""
+    faults.arm("serving.score", "delay", delay_s=0.05, count=20)
+    server = ServingServer(_ScaleModel(2.0), max_batch_size=4,
+                           max_latency_ms=1.0, max_queue=2,
+                           request_timeout_s=5.0,
+                           max_connections=32).start()
+    counts = {200: 0, 503: 0, "error": 0}
+    lock = threading.Lock()
+
+    def client(n=25):
+        for _ in range(n):
+            try:
+                reply = _post(server.url, {"value": 3.0}, timeout=10)
+                assert reply["out"] == 6.0
+                with lock:
+                    counts[200] += 1
+            except urllib.error.HTTPError as e:
+                with lock:
+                    counts[e.code] = counts.get(e.code, 0) + 1
+            except Exception:
+                with lock:
+                    counts["error"] += 1
+
+    threads = [threading.Thread(target=client) for _ in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads), "client deadlock"
+    assert counts[200] > 0
+    assert counts[503] > 0  # backpressure actually shed load
+    health = _get(f"http://{server.host}:{server.port}/healthz")
+    assert health["rejected"] == counts[503] - health["rejectedConnections"]
+    # stop with fresh requests racing in: the flush path must release
+    # any stranded waiter
+    racers = [threading.Thread(target=client, args=(3,)) for _ in range(4)]
+    faults.arm("serving.score", "delay", delay_s=0.2, count=None)
+    for t in racers:
+        t.start()
+    server.stop()
+    for t in racers:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in racers), "deadlock on stop"
