@@ -1,6 +1,9 @@
 """Benchmark: HIGGS-scale LightGBM-parity binary classification fit.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line per metric ({"metric", "value", "unit",
+"vs_baseline"}): the fit-throughput row, then a transform-throughput
+row for batch scoring through the shard-rules engine (recording the
+resolved sharding mode).
 
 Config mirrors the HIGGS-style setup BASELINE.md tracks (28 features,
 binary label, 255 bins, 63 leaves / depth 6) at 2M rows x 100 trees.
@@ -222,6 +225,34 @@ def main():
         "graftsan_disabled_overhead_ns": (
             round(san_disabled_ns, 1) if san_disabled_ns is not None
             else None),
+    }))
+
+    # transform-throughput row: steady-state batch scoring of the
+    # fitted booster through the shard-rules engine (the same path
+    # every model family's transform now routes through). The engine
+    # resolves its placement from the attached mesh — none here, so the
+    # row records the serial mode explicitly; a TPU-pod bench with a
+    # mesh attached reports "rules" + dp without a code change.
+    from mmlspark_tpu.parallel.shard_rules import ShardedScorer
+    xs = x[:min(n, 1_000_000)]
+    scorer = ShardedScorer(jax.jit(result.booster.predict_fn()), None,
+                           family="gbdt", mesh=None, max_batch=65536,
+                           label="bench_transform")
+    scorer(xs[:65536])  # warm: compiles the rung the timed pass uses
+    t0 = time.perf_counter()
+    scorer(xs)
+    dt_t = time.perf_counter() - t0
+    xform_mrow_trees_s = (len(xs) * result.booster.num_trees
+                          / dt_t / 1e6)
+    print(json.dumps({
+        "metric": "gbdt_transform_throughput_higgs28f" + suffix,
+        "value": round(xform_mrow_trees_s, 3),
+        "unit": "Mrow-trees/s",
+        "vs_baseline": None,  # no measured external comparator yet
+        "backend": jax.default_backend(),
+        "rows_scored": len(xs),
+        "transform_s": round(dt_t, 3),
+        **scorer.metadata(),
     }))
 
 

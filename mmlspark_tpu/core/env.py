@@ -157,6 +157,17 @@ SERVE_WARM_MODELS = register(
     "how many served models keep compiled scorers resident (LRU); a "
     "model evicted cold drops its compiled plane + jit cache and "
     "rebuilds lazily on next use")
+SHARD_RULES = register(
+    "MMLSPARK_TPU_SHARD_RULES", "str", "auto",
+    "regex-rule sharding for transform/inference: auto|off|on — auto "
+    "applies the per-family PartitionSpec rule table whenever the "
+    "model carries a mesh, on warns once when no mesh is attached "
+    "(serial fallback), off forces the serial single-device path")
+INFER_AUTOCAST = register(
+    "MMLSPARK_TPU_INFER_AUTOCAST", "str", "off",
+    "inference weight autocast for the shard-rules engine: off|bf16 — "
+    "bf16 casts resident float weights at shard time (off is the "
+    "default and the bitwise-parity-pinned arm)")
 BENCH_PROBE_TIMEOUT_S = register(
     "MMLSPARK_TPU_BENCH_PROBE_TIMEOUT_S", "int", 90,
     "bench.py: seconds per TPU backend probe attempt")

@@ -146,12 +146,14 @@ class DeepModel(Model, _DeepParams):
         """Score with batches sharded over the mesh 'dp' axis (the
         embarrassing-parallel inference mode, ONNXModel.scala:242-251)."""
         self._mesh = mesh
+        self._scorer = None
         return self
 
     def _init_state(self, module, params, classes):
         self._module = module
         self._params = params
         self._classes = np.asarray(classes)
+        self._scorer = None
         return self
 
     def _featurize_x(self, dataset: DataFrame) -> np.ndarray:
@@ -180,6 +182,7 @@ class DeepModel(Model, _DeepParams):
         self._module = module
         self._params = jax.tree_util.tree_unflatten(
             treedef, [jnp.asarray(l) for l in leaves])
+        self._scorer = None
 
     def _rebuild_module(self):
         raise NotImplementedError
@@ -187,41 +190,34 @@ class DeepModel(Model, _DeepParams):
     def _dummy_input(self) -> np.ndarray:
         raise NotImplementedError
 
-    _apply_jit = None
+    _scorer = None
+    _scorer_mesh = None
+
+    def _ensure_scorer(self, batch: int = 256):
+        """Shared scoring engine: params resident on-device under the
+        dl rule table, batches bucket-padded and row-sharded over dp
+        (cached per instance — a fresh engine per call would re-shard
+        the params and recompile). Rebuilt if ``_mesh`` changed under
+        us (tests poke it directly)."""
+        if self._scorer is not None and self._scorer_mesh is not self._mesh:
+            self._scorer = None
+        if self._scorer is None:
+            from mmlspark_tpu.parallel.shard_rules import ShardedScorer
+            module = self._module
+            self._scorer = ShardedScorer(
+                lambda p, xb: module.apply(p, xb), self._params,
+                family="dl", mesh=self._mesh, max_batch=batch,
+                label=type(self).__name__)
+            self._scorer_mesh = self._mesh
+        return self._scorer
+
+    def shard_metadata(self) -> Dict[str, Any]:
+        """Resolved sharding mode + reason (the warn-once downgrade
+        contract's queryable side)."""
+        return self._ensure_scorer().metadata()
 
     def _logits(self, x: np.ndarray, batch: int = 256) -> np.ndarray:
-        import jax
-        import jax.numpy as jnp
-
-        if self._apply_jit is None:
-            # cache per instance: a fresh jit wrapper per call would
-            # retrace + recompile on every transform
-            self._apply_jit = jax.jit(
-                lambda p, xb: self._module.apply(p, xb))
-        apply = self._apply_jit
-        if self._mesh is not None:
-            # dp-sharded scoring: params replicate, rows shard; round
-            # the chunk size so full chunks tile the dp axis evenly
-            from mmlspark_tpu.parallel.mesh import axis_size
-            dp = axis_size(self._mesh, DATA_AXIS)
-            batch = max(((batch + dp - 1) // dp) * dp, dp)
-        outs = []
-        for s in range(0, len(x), batch):
-            xb = x[s:s + batch]
-            # pad the tail chunk to the full batch shape so the jitted
-            # forward compiles exactly once
-            pad = 0
-            if len(xb) < batch and len(x) > batch:
-                pad = batch - len(xb)
-                xb = np.concatenate([xb, np.repeat(xb[-1:], pad, axis=0)])
-            if self._mesh is not None:
-                from mmlspark_tpu.parallel.inference import sharded_apply
-                o = sharded_apply(lambda b: apply(self._params, b), xb,
-                                  self._mesh)
-            else:
-                o = np.asarray(apply(self._params, jnp.asarray(xb)))
-            outs.append(o[:len(o) - pad] if pad else o)
-        return np.concatenate(outs)
+        return np.asarray(self._ensure_scorer(batch)(x))
 
     def _transform(self, dataset: DataFrame) -> DataFrame:
         import jax

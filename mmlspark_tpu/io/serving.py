@@ -136,34 +136,27 @@ class _Pending:
 
 
 def _bucket_ladder(max_batch_size: int) -> List[int]:
-    """Padded compile shapes for the binned data plane: powers of two
-    capped at (and always containing) ``max_batch_size``, overridable
-    via MMLSPARK_TPU_SERVE_BUCKETS as a comma-separated size list.
-    Small and fixed by construction — the scorer compiles at most
-    ``len(ladder)`` graphs regardless of how request batch sizes vary."""
+    """Padded compile shapes for the binned data plane: the shared
+    pow2 ladder from :mod:`mmlspark_tpu.parallel.inference` (also used
+    by the shard-rules scoring engine, so serving and transform pad to
+    the same rungs), overridable via MMLSPARK_TPU_SERVE_BUCKETS as a
+    comma-separated size list. Small and fixed by construction — the
+    scorer compiles at most ``len(ladder)`` graphs regardless of how
+    request batch sizes vary."""
+    from mmlspark_tpu.parallel.inference import bucket_ladder
     spec = (env_str(SERVE_BUCKETS, "") or "").strip()
+    buckets = None
     if spec:
         try:
-            sizes = sorted({int(tok) for tok in spec.split(",")
-                            if tok.strip()})
+            buckets = [int(tok) for tok in spec.split(",")
+                       if tok.strip()]
         except ValueError:
             warn_once(
                 "serving.buckets",
                 "%s=%r is not a comma-separated int list; using the "
                 "power-of-two ladder", SERVE_BUCKETS, spec)
-            sizes = []
-        sizes = [s for s in sizes if 0 < s <= max_batch_size]
-        if sizes:
-            if sizes[-1] != max_batch_size:
-                sizes.append(max_batch_size)
-            return sizes
-    sizes = []
-    b = 1
-    while b < max_batch_size:
-        sizes.append(b)
-        b *= 2
-    sizes.append(max_batch_size)
-    return sizes
+            buckets = None
+    return bucket_ladder(max_batch_size, buckets)
 
 
 class _BinnedPlane:
@@ -452,13 +445,23 @@ class ServingServer:
     # -- health --------------------------------------------------------------
     def _model_health(self, served: _ServedModel) -> Dict[str, Any]:
         with self._lock:
-            return {"name": served.name, "queueDepth": len(served.queue),
-                    "maxQueue": served.max_queue,
-                    "warm": served.name in self._warm,
-                    "binned": {"mode": served.binned_mode,
-                               "active": served.plane is not None,
-                               "reason": served.binned_reason},
-                    **served.stats}
+            health = {"name": served.name, "queueDepth": len(served.queue),
+                      "maxQueue": served.max_queue,
+                      "warm": served.name in self._warm,
+                      "binned": {"mode": served.binned_mode,
+                                 "active": served.plane is not None,
+                                 "reason": served.binned_reason},
+                      **served.stats}
+            # resolved shard-rules mode/reason for models scored through
+            # the shared engine (the warn-once downgrade contract's
+            # queryable side)
+            meta = getattr(served.model, "shard_metadata", None)
+            if callable(meta):
+                try:
+                    health["shard_rules"] = meta()
+                except Exception:  # health must never take a model down
+                    pass
+            return health
 
     def _models_listing(self) -> Dict[str, Any]:
         return {"default": self._default,

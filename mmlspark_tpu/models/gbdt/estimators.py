@@ -733,18 +733,50 @@ class _LightGBMModelBase(Model, _LightGBMParams):
                 key, self.booster, self.booster.slice_iterations(s, m))
         return self._sliced_cache[2]
 
+    _scorers = None
+
     def set_mesh(self, mesh) -> "_LightGBMModelBase":
         """Score with rows sharded over the mesh 'dp' axis (embarrassing
         parallel inference, ONNXModel.scala:242-251 analog). Inherited
         from the estimator's mesh at fit time."""
         self._mesh = mesh
+        self._scorers = None
         return self
 
-    def _score(self, fn, x: np.ndarray) -> np.ndarray:
-        if self._mesh is not None:
-            from mmlspark_tpu.parallel.inference import sharded_apply
-            return sharded_apply(fn, x, self._mesh)
-        return np.asarray(fn(x))
+    def _score(self, fn, x: np.ndarray,
+               label: str = "predict") -> np.ndarray:
+        """Route a jitted booster closure through the shared scoring
+        engine (closure mode: the tree arrays are jit constants, so the
+        gbdt rule table replicates them by construction). Engines cache
+        per label and invalidate when the underlying closure changes
+        (booster slice, cleared jit cache)."""
+        from mmlspark_tpu.parallel.shard_rules import ShardedScorer
+        if self._scorers is None:
+            self._scorers = {}
+        ent = self._scorers.get(label)
+        if ent is None or ent[0] is not fn:
+            scorer = ShardedScorer(fn, None, family="gbdt",
+                                   mesh=self._mesh, max_batch=65536,
+                                   label=label)
+            ent = (fn, scorer)
+            self._scorers[label] = ent
+        return np.asarray(ent[1](x))
+
+    def shard_metadata(self) -> Dict[str, Any]:
+        """Resolved sharding mode + reason (the warn-once downgrade
+        contract's queryable side)."""
+        from mmlspark_tpu.parallel.mesh import DATA_AXIS, axis_size
+        from mmlspark_tpu.parallel.shard_rules import (
+            resolve_infer_autocast, resolve_shard_rules)
+        if self._scorers:
+            return next(iter(self._scorers.values()))[1].metadata()
+        mode, reason = resolve_shard_rules(
+            self._mesh, label=type(self).__name__)
+        dp = (axis_size(self._mesh, DATA_AXIS) if mode == "rules" else 1)
+        return {"shard_rules": mode, "shard_rules_reason": reason,
+                "shard_rules_family": "gbdt",
+                "infer_autocast": resolve_infer_autocast(),
+                "shard_rules_dp": dp}
 
     def _raw_scores(self, x: np.ndarray) -> np.ndarray:
         """Margin scores for raw features: the binned-compare path when
@@ -765,8 +797,9 @@ class _LightGBMModelBase(Model, _LightGBMParams):
                 x = np.where(x == 0.0, np.nan, x)
             xb = self.bin_mapper.transform(x).astype(
                 binned_ingest_dtype(self.bin_mapper.max_num_bins))
-            return self._score(b.predict_binned_jit(), xb)
-        return self._score(b.predict_jit(), x)
+            return self._score(b.predict_binned_jit(), xb,
+                               label="predict_binned")
+        return self._score(b.predict_jit(), x, label="predict")
 
     def _init_empty(self):
         self.booster = None
@@ -826,11 +859,13 @@ class _LightGBMModelBase(Model, _LightGBMParams):
 
     def _maybe_extra_cols(self, df: DataFrame, x: np.ndarray) -> DataFrame:
         if self.is_set("leafPredictionCol"):
-            leaves = self._score(self.scoring_booster.leaf_index_jit(), x)
+            leaves = self._score(self.scoring_booster.leaf_index_jit(), x,
+                                 label="leaf_index")
             df = df.with_column(self.get("leafPredictionCol"),
                                 leaves.astype(np.float64))
         if self.is_set("featuresShapCol"):
-            contribs = self._score(self.scoring_booster.contrib_jit(), x)
+            contribs = self._score(self.scoring_booster.contrib_jit(), x,
+                                   label="contrib")
             df = df.with_column(self.get("featuresShapCol"),
                                 contribs.astype(np.float64))
         return df

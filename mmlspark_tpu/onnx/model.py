@@ -40,7 +40,7 @@ class ONNXModel(Transformer):
                        "post-op", is_complex=True)
 
     _graph: Optional[OnnxGraph] = None
-    _run = None
+    _scorer = None
     _mesh = None
 
     def set_model_location(self, path: str) -> "ONNXModel":
@@ -53,6 +53,7 @@ class ONNXModel(Transformer):
         embarrassing-parallel scoring mode (model broadcast + partition
         scoring, onnx/ONNXModel.scala:242-251)."""
         self._mesh = mesh
+        self._scorer = None
         return self
 
     def _ensure_graph(self):
@@ -61,9 +62,26 @@ class ONNXModel(Transformer):
             outputs = list(fetch.values()) or None
             self._graph = OnnxGraph(load_model(self.get("modelPayload")),
                                     outputs)
-            import jax
-            self._run = jax.jit(self._graph.convert())
+            self._scorer = None
         return self._graph
+
+    def _ensure_scorer(self):
+        """The shared scoring engine: float initializers lifted into a
+        params pytree resident on-device under the onnx rule table,
+        batches bucket-padded and row-sharded over dp."""
+        self._ensure_graph()
+        if self._scorer is None:
+            from mmlspark_tpu.parallel.shard_rules import ShardedScorer
+            run, weights = self._graph.convert_trainable()
+            self._scorer = ShardedScorer(
+                run, weights, family="onnx", mesh=self._mesh,
+                max_batch=self.get("miniBatchSize"), label="onnx")
+        return self._scorer
+
+    def shard_metadata(self) -> Dict[str, Any]:
+        """Resolved sharding mode + reason (the warn-once downgrade
+        contract's queryable side)."""
+        return self._ensure_scorer().metadata()
 
     @property
     def model_inputs(self) -> Dict[str, tuple]:
@@ -75,42 +93,34 @@ class ONNXModel(Transformer):
 
     def _transform(self, dataset: DataFrame) -> DataFrame:
         graph = self._ensure_graph()
+        scorer = self._ensure_scorer()
         feed = self.get("feedDict") or {
             graph.input_names[0]: "features"}
         fetch = self.get("fetchDict") or {
             "output": graph.output_names[0]}
-        bs = self.get("miniBatchSize")
-        n = dataset.num_rows
 
-        cols: Dict[str, List[np.ndarray]] = {c: [] for c in fetch}
-        for start in range(0, n, bs):
-            feeds = {}
-            for input_name, col_name in feed.items():
-                col = dataset.col(col_name)
-                if col.dtype == object:
-                    batch = np.stack([np.asarray(v)
-                                      for v in col[start:start + bs]])
-                else:
-                    batch = col[start:start + bs]
-                # honor the graph's declared input dtype; otherwise keep
-                # int/bool columns intact and only downcast f64 -> f32
-                declared = graph.input_dtypes.get(input_name)
-                if declared is not None:
-                    batch = np.asarray(batch, declared)
-                elif batch.dtype == np.float64:
-                    batch = batch.astype(np.float32)
-                feeds[input_name] = np.asarray(batch)
-            if self._mesh is not None:
-                from mmlspark_tpu.parallel.inference import sharded_apply
-                fetched = sharded_apply(self._run, feeds, self._mesh)
+        feeds = {}
+        for input_name, col_name in feed.items():
+            col = dataset.col(col_name)
+            if col.dtype == object:
+                batch = np.stack([np.asarray(v) for v in col])
             else:
-                fetched = self._run(feeds)
-            for out_col, tensor_name in fetch.items():
-                cols[out_col].append(np.asarray(fetched[tensor_name]))
+                batch = col
+            # honor the graph's declared input dtype; otherwise keep
+            # int/bool columns intact and only downcast f64 -> f32
+            declared = graph.input_dtypes.get(input_name)
+            if declared is not None:
+                batch = np.asarray(batch, declared)
+            elif batch.dtype == np.float64:
+                batch = batch.astype(np.float32)
+            feeds[input_name] = np.asarray(batch)
+        # one engine call: the scorer chunks to miniBatchSize-capped
+        # bucket rungs internally and keeps weights resident on-device
+        fetched = scorer(feeds)
 
         out = dataset
-        for out_col in fetch:
-            stacked = np.concatenate(cols[out_col])
+        for out_col, tensor_name in fetch.items():
+            stacked = np.asarray(fetched[tensor_name])
             if stacked.ndim > 2:  # ragged-safe object column
                 obj = np.empty(len(stacked), dtype=object)
                 for i in range(len(stacked)):
@@ -135,6 +145,7 @@ class ONNXModel(Transformer):
         (ONNXModel.sliceAtOutputs parity)."""
         clone = self.copy(fetchDict={output_col: tensor_name})
         clone._graph = None
+        clone._scorer = None
         return clone
 
 
@@ -185,6 +196,7 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
                 feedDict={graph.input_names[0]: "__img__"},
                 fetchDict={self.get("outputCol"): graph.all_output_names[0]})
         scorer._graph = None
+        scorer._scorer = None
         out = scorer.transform(df)
         feats = out.col(self.get("outputCol"))
         if feats.dtype == object:  # flatten feature maps to vectors

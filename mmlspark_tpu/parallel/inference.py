@@ -10,7 +10,7 @@ collectives in the scoring graph at all.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, List, Optional
 
 import numpy as np
 
@@ -18,14 +18,50 @@ from mmlspark_tpu.parallel.mesh import DATA_AXIS, axis_size, row_sharded
 
 
 def pad_rows(x: np.ndarray, multiple: int) -> tuple:
-    """Pad the leading dim to a multiple (repeating the last row so
-    padded rows stay shape-valid); returns (padded, n_valid)."""
+    """Pad the leading dim up to a multiple with zero rows; returns
+    (padded, n_valid). Scorers are row-independent, so zero rows are
+    output-safe (their outputs are sliced away) and cheaper than
+    repeating real data. An empty batch pads up to one full multiple
+    so downstream sharding constraints (leading dim divisible by the
+    mesh axis) always hold."""
     n = x.shape[0]
-    padded = ((n + multiple - 1) // multiple) * multiple
-    if padded == n or n == 0:
+    if multiple <= 1:
         return x, n
-    reps = np.repeat(x[-1:], padded - n, axis=0)
-    return np.concatenate([x, reps]), n
+    padded = max(((n + multiple - 1) // multiple) * multiple, multiple)
+    if padded == n:
+        return x, n
+    fill = np.zeros((padded - n,) + x.shape[1:], dtype=x.dtype)
+    return np.concatenate([x, fill]), n
+
+
+def bucket_ladder(max_batch: int, buckets: Optional[List[int]] = None
+                  ) -> List[int]:
+    """Pow2 padding ladder ending at ``max_batch`` (ascending).
+
+    Shared by the serving data plane and the shard-rules scoring
+    engine so both pad to the same rungs and the jitted scorer
+    compiles once per rung. ``buckets`` overrides the ladder (values
+    are clamped into [1, max_batch]; max_batch is always included so
+    every batch has a rung)."""
+    max_batch = max(int(max_batch), 1)
+    if buckets:
+        ladder = sorted({min(max(int(b), 1), max_batch) for b in buckets}
+                        | {max_batch})
+        return ladder
+    ladder, b = [], 1
+    while b < max_batch:
+        ladder.append(b)
+        b *= 2
+    ladder.append(max_batch)
+    return ladder
+
+
+def bucket_for(n: int, ladder: List[int]) -> int:
+    """Smallest rung >= n (top rung when n exceeds the ladder)."""
+    for b in ladder:
+        if n <= b:
+            return b
+    return ladder[-1]
 
 
 def sharded_apply(fn: Callable, x: Any, mesh, axis: str = DATA_AXIS):
@@ -42,17 +78,19 @@ def sharded_apply(fn: Callable, x: Any, mesh, axis: str = DATA_AXIS):
     if isinstance(x, dict):
         n = next(iter(x.values())).shape[0]
         fed = {}
+        padded = n
         for k, v in x.items():
             pv, _ = pad_rows(np.asarray(v), size)
+            padded = pv.shape[0]
             fed[k] = jax.device_put(pv, row_sharded(mesh, pv.ndim, axis))
         out = fn(fed)
     else:
         x = np.asarray(x)
         n = x.shape[0]
         pv, _ = pad_rows(x, size)
+        padded = pv.shape[0]
         xd = jax.device_put(pv, row_sharded(mesh, pv.ndim, axis))
         out = fn(xd)
-    padded = ((n + size - 1) // size) * size
 
     def unpad(a):
         a = np.asarray(a)

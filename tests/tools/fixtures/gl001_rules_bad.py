@@ -1,0 +1,13 @@
+"""GL001 fixture: rule tables with typo'd axis names (NEVER imported)."""
+
+BAD_RULES = [
+    (r".*embedding.*", ("dq", None)),      # typo: not dp
+    (r".*kernel$", (None, "model")),       # undeclared axis
+    (r".*", ()),                           # catch-all: replicated, fine
+]
+
+NESTED_RULES = (
+    (r".*", (("rows",), None)),            # nested tuple, undeclared
+)
+
+NOT_A_TABLE = [("dz", "also_not_checked")]  # name doesn't end in _RULES

@@ -1,0 +1,14 @@
+"""GL001 fixture: rule tables using declared axes only (NEVER imported)."""
+
+from mmlspark_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+CLEAN_RULES = [
+    (r".*embedding.*", (MODEL_AXIS, None)),   # constants skipped
+    (r".*kernel$", (None, "mp")),             # declared literal
+    (r".*bias$", ("dp",)),                    # declared literal
+    (r".*", ()),                              # replicated catch-all
+]
+
+EXTRA_RULES = (
+    (r".*", ((DATA_AXIS, "fp"), None)),       # nested, all declared
+)

@@ -52,6 +52,30 @@ def test_gl001_clean_fixture_passes():
     assert lint([FIXTURES / "gl001_clean.py"], select=["GL001"]) == []
 
 
+def test_gl001_catches_bad_rule_table_axes():
+    found = lint([FIXTURES / "gl001_rules_bad.py"], select=["GL001"])
+    msgs = messages(found)
+    assert any("'dq'" in m for m in msgs), msgs
+    assert any("'model'" in m for m in msgs), msgs
+    assert any("'rows'" in m for m in msgs), msgs
+    assert all("rule table" in m for m in msgs), msgs
+    # regex halves, catch-alls, and non-_RULES tables are never flagged
+    assert len(found) == 3
+    assert all(f.rule == "GL001" and f.severity == "error"
+               for f in found)
+
+
+def test_gl001_rules_clean_fixture_passes():
+    assert lint([FIXTURES / "gl001_rules_clean.py"],
+                select=["GL001"]) == []
+
+
+def test_gl001_shard_rules_tables_resolve():
+    # the shipped per-family tables are the no-false-positive bar
+    assert lint([PACKAGE / "parallel" / "shard_rules.py"],
+                select=["GL001"]) == []
+
+
 # --- GL002 ---------------------------------------------------------------
 
 def test_gl002_catches_impure_jit_body():

@@ -75,6 +75,36 @@ class CollectiveAxisChecker(Checker):
                             out.append(self._finding(
                                 pf, lit, lit.value, "PartitionSpec",
                                 valid))
+        out.extend(self._check_rule_tables(pf, valid))
+        return out
+
+    def _check_rule_tables(self, pf: ParsedFile,
+                           valid: Set[str]) -> List[Finding]:
+        """Module-level ``*_RULES`` tables — lists of ``(regex, spec)``
+        pairs consumed by ``parallel/shard_rules.py`` — carry axis names
+        in their spec halves exactly like PartitionSpec literals; a typo
+        there silently downgrades a whole model family to replication."""
+        out: List[Finding] = []
+        for stmt in pf.tree.body:
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            else:
+                continue
+            names = [t.id for t in targets if isinstance(t, ast.Name)
+                     and t.id.endswith("_RULES")]
+            if not names or not isinstance(value, (ast.List, ast.Tuple)):
+                continue
+            for entry in value.elts:
+                if not (isinstance(entry, (ast.Tuple, ast.List))
+                        and len(entry.elts) == 2):
+                    continue
+                for lit in _spec_literals(entry.elts[1]):
+                    if lit.value not in valid:
+                        out.append(self._finding(
+                            pf, lit, lit.value,
+                            f"rule table {names[0]}", valid))
         return out
 
     def _check_axis_expr(self, pf: ParsedFile, call: ast.Call, op: str,
@@ -161,6 +191,21 @@ def _axis_candidates(pf: ParsedFile, call: ast.Call, expr: ast.AST,
         if value is not None:
             return [(value, expr)]
     return []
+
+
+def _spec_literals(spec: ast.AST, depth: int = 0) -> List[ast.Constant]:
+    """String literals in one rule-table spec (an axis name or an
+    arbitrarily nested tuple of axis names; None means replicated,
+    ``*_AXIS`` constants are valid by construction and skipped)."""
+    if depth > 3:
+        return []
+    if isinstance(spec, ast.Constant) and isinstance(spec.value, str):
+        return [spec]
+    out: List[ast.Constant] = []
+    if isinstance(spec, (ast.Tuple, ast.List)):
+        for el in spec.elts:
+            out.extend(_spec_literals(el, depth + 1))
+    return out
 
 
 def _pspec_literals(arg: ast.AST) -> List[ast.Constant]:
