@@ -255,6 +255,50 @@ def main():
         **scorer.metadata(),
     }))
 
+    # dl fit-throughput row: steady-state epochs/s of the deep text
+    # fit loop — the sharded-training-state (MMLSPARK_TPU_TRAIN_SHARD)
+    # + async-input-pipeline data point. The resolved mode, the
+    # prefetch state, and the analytic optimizer-memory split ride in
+    # the row so an A/B between rounds is attributable without a rerun.
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.dl.text import DeepTextClassifier
+    from mmlspark_tpu.parallel.mesh import default_mesh
+    dl_rows = int(os.environ.get("BENCH_DL_ROWS", 4096))
+    dl_epochs = 2
+    words = np.array(["alpha", "beta", "gamma", "delta", "epsilon",
+                      "zeta", "eta", "theta", "iota", "kappa"])
+    docs = rng.choice(words, size=(dl_rows, 12))
+    dl_y = (docs == "alpha").sum(axis=1) > 1
+    dl_df = DataFrame({"text": [" ".join(d) for d in docs],
+                       "label": dl_y.astype(np.float64)})
+    def dl_fit():
+        return DeepTextClassifier(
+            mesh=default_mesh(), batchSize=256, maxEpochs=dl_epochs,
+            labelCol="label", textCol="text", maxLength=16,
+            embeddingDim=32, numLayers=1, numHeads=2).fit(dl_df)
+    dl_fit()  # warm: identical shapes, compiled step cached
+    t0 = time.perf_counter()
+    dl_model = dl_fit()
+    dt_dl = time.perf_counter() - t0
+    dl_meta = dl_model.shard_metadata()
+    dl_suffix = "_cpu_fallback" if on_cpu and not intended_cpu else ""
+    if dl_rows != 4096:
+        dl_suffix += f"_rows{dl_rows}"
+    print(json.dumps({
+        "metric": "dl_fit_throughput" + dl_suffix,
+        "value": round(dl_rows * dl_epochs / dt_dl, 1),
+        "unit": "rows/s",
+        "vs_baseline": None,  # no measured external comparator yet
+        "backend": jax.default_backend(),
+        "fit_s": round(dt_dl, 3),
+        "epochs": dl_epochs,
+        **{k: dl_meta.get(k)
+           for k in ("train_shard", "train_shard_reason",
+                     "train_shard_dp", "prefetch", "prefetch_depth",
+                     "opt_state_bytes_per_device",
+                     "opt_state_bytes_replicated")},
+    }))
+
 
 def serving_sustained_main():
     """``python bench.py --serving-sustained``: the serving-path row —

@@ -168,6 +168,20 @@ INFER_AUTOCAST = register(
     "inference weight autocast for the shard-rules engine: off|bf16 — "
     "bf16 casts resident float weights at shard time (off is the "
     "default and the bitwise-parity-pinned arm)")
+TRAIN_SHARD = register(
+    "MMLSPARK_TPU_TRAIN_SHARD", "str", "auto",
+    "ZeRO-1 sharded training state for the dl fit loop: auto|off|on — "
+    "partition optimizer moments (and the weight update) across dp via "
+    "the DL_TRAIN_RULES table, reduce-scatter grads and all-gather "
+    "updated params (arXiv:2004.13336); auto activates when the fit "
+    "mesh has a dp axis, on warns once when it cannot, off keeps the "
+    "fully replicated update")
+PREFETCH_DEPTH = register(
+    "MMLSPARK_TPU_PREFETCH_DEPTH", "int", 2,
+    "batches the async input pipeline (parallel/prefetch.py) stages "
+    "ahead of the training step on a background thread (device_put "
+    "overlapped with compute); 0 disables the thread and feeds batches "
+    "synchronously")
 BENCH_PROBE_TIMEOUT_S = register(
     "MMLSPARK_TPU_BENCH_PROBE_TIMEOUT_S", "int", 90,
     "bench.py: seconds per TPU backend probe attempt")

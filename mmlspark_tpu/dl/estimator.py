@@ -37,6 +37,19 @@ class _DeepParams(HasLabelCol, HasPredictionCol):
     seed = Param("seed", "rng seed", to_int, default=0)
 
 
+def _fetch_epoch_loss(loss_acc, steps: int) -> float:
+    """The fit loop's ONE host sync per epoch: pull the device-side
+    loss accumulator and return the epoch-mean loss. Module-level so
+    the no-per-step-sync contract is a spyable seam
+    (tests/parallel/test_train_shard.py counts calls and
+    block_until_ready-probes the accumulator)."""
+    import jax
+
+    if loss_acc is None:
+        return float("nan")
+    return float(jax.device_get(loss_acc)) / max(steps, 1)
+
+
 class DeepEstimator(Estimator, _DeepParams):
     """Subclasses implement :meth:`_build_module` (flax nn.Module),
     :meth:`_featurize` (DataFrame -> (x, y) numpy), and
@@ -60,6 +73,12 @@ class DeepEstimator(Estimator, _DeepParams):
         import jax.numpy as jnp
         import optax
 
+        from mmlspark_tpu.parallel.prefetch import (BatchPrefetcher,
+                                                    resolve_prefetch_depth)
+        from mmlspark_tpu.parallel.shard_rules import (
+            resolve_train_shard, train_state_bytes_per_device,
+            train_state_shardings)
+
         x, y_raw = self._featurize(dataset)
         classes = np.unique(y_raw)
         # train on dense class indices so non-contiguous labels (e.g.
@@ -72,7 +91,14 @@ class DeepEstimator(Estimator, _DeepParams):
         rng = jax.random.PRNGKey(self.get("seed"))
         params = module.init(rng, jnp.asarray(x[:1]))
 
-        steps_per_epoch = max(len(x) // self.get("batchSize"), 1)
+        from mmlspark_tpu.parallel.mesh import axis_size
+        dp = (axis_size(mesh, DATA_AXIS)
+              if DATA_AXIS in mesh.axis_names else 1)
+        # batch must tile evenly over the dp axis (static shapes); the
+        # step count must follow the EFFECTIVE batch size — dividing by
+        # the raw batchSize over-counted steps whenever dp rounded it up
+        bs = max(((self.get("batchSize") + dp - 1) // dp) * dp, dp)
+        steps_per_epoch = max(len(x) // bs, 1)
         total_steps = steps_per_epoch * self.get("maxEpochs")
         schedule = optax.cosine_decay_schedule(
             self.get("learningRate"), decay_steps=max(total_steps, 1))
@@ -81,7 +107,8 @@ class DeepEstimator(Estimator, _DeepParams):
 
         from jax.sharding import NamedSharding, PartitionSpec as P
         repl = NamedSharding(mesh, P())
-        batch_sharded = NamedSharding(mesh, P(DATA_AXIS))
+        batch_sharded = NamedSharding(
+            mesh, P(DATA_AXIS) if DATA_AXIS in mesh.axis_names else P())
 
         def loss_fn(p, xb, yb):
             logits = module.apply(p, xb)
@@ -89,44 +116,109 @@ class DeepEstimator(Estimator, _DeepParams):
             ll = optax.softmax_cross_entropy(logits, onehot)
             return ll.mean(), logits
 
-        @jax.jit
-        def train_step(p, opt, xb, yb):
-            (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                p, xb, yb)
-            updates, opt = tx.update(grads, opt, p)
-            p = optax.apply_updates(p, updates)
-            return p, opt, loss
+        label = type(self).__name__
+        mode, reason = resolve_train_shard(mesh, label=label)
+        opt_bytes_full = sum(
+            int(np.prod(getattr(l, "shape", ()) or (1,)))
+            * np.dtype(getattr(l, "dtype", np.float32)).itemsize
+            for l in jax.tree_util.tree_leaves(opt_state))
+        if mode == "sharded":
+            # ZeRO-1 (arXiv:2004.13336): optimizer moments and the
+            # weight update partition over dp under DL_TRAIN_RULES.
+            # Constraining grads to the moment placement turns the
+            # gradient all-reduce into a reduce-scatter; each replica
+            # updates only the shard it owns, and constraining the new
+            # params back to replicated is the all-gather.
+            grad_shardings = train_state_shardings(params, mesh,
+                                                   label=label)
+            opt_shardings = train_state_shardings(opt_state, mesh,
+                                                  label=f"{label}:opt")
+            repl_params = jax.tree_util.tree_map(lambda _: repl, params)
+            opt_bytes_dev = train_state_bytes_per_device(
+                opt_state, mesh, label=f"{label}:opt")
 
-        # replicate params/opt state; shard batches on dp — XLA derives
-        # the gradient all-reduce from the shardings
-        params = jax.device_put(params, repl)
-        opt_state = jax.device_put(opt_state, repl)
+            def step_fn(p, opt, xb, yb):
+                (loss, _), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(p, xb, yb)
+                grads = jax.lax.with_sharding_constraint(
+                    grads, grad_shardings)
+                updates, opt = tx.update(grads, opt, p)
+                p = optax.apply_updates(p, updates)
+                p = jax.lax.with_sharding_constraint(p, repl_params)
+                return p, opt, loss
 
-        from mmlspark_tpu.parallel.mesh import axis_size
-        dp = axis_size(mesh, DATA_AXIS)
-        # batch must tile evenly over the dp axis (static shapes)
-        bs = max(((self.get("batchSize") + dp - 1) // dp) * dp, dp)
+            params = jax.device_put(params, repl)
+            opt_state = jax.device_put(opt_state, opt_shardings)
+        else:
+            # replicated update: params/opt state replicated, batch
+            # sharded on dp — XLA derives the gradient all-reduce
+            opt_bytes_dev = opt_bytes_full
+
+            def step_fn(p, opt, xb, yb):
+                (loss, _), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(p, xb, yb)
+                updates, opt = tx.update(grads, opt, p)
+                p = optax.apply_updates(p, updates)
+                return p, opt, loss
+
+            params = jax.device_put(params, repl)
+            opt_state = jax.device_put(opt_state, repl)
+
+        # donate the carried train state (params + opt moments are
+        # rewritten every step); not on XLA:CPU, where device_put
+        # aliases host numpy (same guard as ShardedScorer)
+        donate = (0, 1) if jax.default_backend() != "cpu" else ()
+        train_step = jax.jit(step_fn, donate_argnums=donate)
+
         nrng = np.random.default_rng(self.get("seed"))
+
+        def epoch_batches(order):
+            for s in range(steps_per_epoch):
+                idx = order[s * bs:(s + 1) * bs]
+                if len(idx) < bs:  # static shapes: wrap-pad the tail
+                    idx = np.concatenate(
+                        [idx, order[np.arange(bs - len(idx))
+                                    % len(order)]])
+                yield x[idx], y[idx]
+
+        def place(batch):
+            xb, yb = batch
+            return (jax.device_put(xb, batch_sharded),
+                    jax.device_put(yb, batch_sharded))
+
         watch = StopWatch()
         history: List[float] = []
+        prefetch_async = resolve_prefetch_depth() > 0
         with watch.measure():
             for _ in range(self.get("maxEpochs")):
                 order = nrng.permutation(len(x))
-                for s in range(steps_per_epoch):
-                    idx = order[s * bs:(s + 1) * bs]
-                    if len(idx) < bs:  # static shapes: wrap-pad the tail
-                        idx = np.concatenate(
-                            [idx, order[np.arange(bs - len(idx))
-                                        % len(order)]])
-                    xb = jax.device_put(jnp.asarray(x[idx]), batch_sharded)
-                    yb = jax.device_put(jnp.asarray(y[idx]), batch_sharded)
-                    params, opt_state, loss = train_step(
-                        params, opt_state, xb, yb)
-                history.append(float(loss))
+                # device-side loss accumulation: the only host sync per
+                # epoch is the single fetch below — per-step float()
+                # would serialize the async dispatch pipeline
+                loss_acc = None
+                with BatchPrefetcher(epoch_batches(order), place,
+                                     label=f"{label}.fit") as pf:
+                    prefetch_async = prefetch_async and pf.async_mode
+                    for xb, yb in pf:
+                        params, opt_state, loss = train_step(
+                            params, opt_state, xb, yb)
+                        loss_acc = (loss if loss_acc is None
+                                    else loss_acc + loss)
+                history.append(_fetch_epoch_loss(loss_acc,
+                                                 steps_per_epoch))
         model = self._make_model(module, jax.device_get(params), classes)
         model.train_seconds = watch.elapsed
         model.loss_history = history
         model._mesh = mesh
+        model._train_meta = {
+            "train_shard": mode,
+            "train_shard_reason": reason,
+            "train_shard_dp": dp,
+            "opt_state_bytes_per_device": opt_bytes_dev,
+            "opt_state_bytes_replicated": opt_bytes_full,
+            "prefetch": "on" if prefetch_async else "off",
+            "prefetch_depth": resolve_prefetch_depth(),
+        }
         return model
 
 
@@ -136,6 +228,7 @@ class DeepModel(Model, _DeepParams):
 
     train_seconds: float = 0.0
     loss_history: List[float] = []
+    _train_meta: Optional[Dict[str, Any]] = None
 
     _module = None
     _params = None
@@ -213,8 +306,12 @@ class DeepModel(Model, _DeepParams):
 
     def shard_metadata(self) -> Dict[str, Any]:
         """Resolved sharding mode + reason (the warn-once downgrade
-        contract's queryable side)."""
-        return self._ensure_scorer().metadata()
+        contract's queryable side) — scoring placement from the engine,
+        training-state placement recorded by the fit that built us."""
+        meta = self._ensure_scorer().metadata()
+        if self._train_meta:
+            meta.update(self._train_meta)
+        return meta
 
     def _logits(self, x: np.ndarray, batch: int = 256) -> np.ndarray:
         return np.asarray(self._ensure_scorer(batch)(x))

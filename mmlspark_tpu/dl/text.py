@@ -23,12 +23,22 @@ from mmlspark_tpu.ops.hashing import murmur3_32
 
 def hash_tokenize(texts: List[str], max_len: int, vocab_size: int
                   ) -> np.ndarray:
-    """Whitespace tokens -> hashed ids in [1, vocab); 0 is padding."""
+    """Whitespace tokens -> hashed ids in [1, vocab); 0 is padding.
+
+    Token ids are memoized per call: natural text repeats tokens
+    heavily (Zipf), and the pure-Python murmur3 is the input pipeline's
+    host hot spot — one hash per distinct token, not per occurrence.
+    """
     out = np.zeros((len(texts), max_len), np.int32)
+    seen: dict = {}
+    mod = vocab_size - 1
     for i, t in enumerate(texts):
         toks = str(t).lower().split()[:max_len]
         for j, tok in enumerate(toks):
-            out[i, j] = (murmur3_32(tok) % (vocab_size - 1)) + 1
+            tid = seen.get(tok)
+            if tid is None:
+                tid = seen[tok] = (murmur3_32(tok) % mod) + 1
+            out[i, j] = tid
     return out
 
 

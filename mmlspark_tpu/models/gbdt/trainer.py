@@ -1858,6 +1858,15 @@ def _make_step_fn(num_f: int, total_bins: int, cfg: TrainConfig, k: int,
             if data.get("group_layout") is not None:
                 okw["group_layout"] = data["group_layout"]
         g, h = objective_fn(score_in, labels, weights, **okw)
+        if mode == "data_sharded" and mesh is not None:
+            # pin the per-round grad/hess recompute to the dp slice
+            # owning the rows — the sharded histogram builder consumes
+            # them shard-local, so nothing may force a gather here
+            from mmlspark_tpu.parallel.mesh import row_sharded
+            g = jax.lax.with_sharding_constraint(
+                g, row_sharded(mesh, g.ndim))
+            h = jax.lax.with_sharding_constraint(
+                h, row_sharded(mesh, h.ndim))
 
         if is_goss:
             absg = jnp.abs(g) if k == 1 else jnp.sum(jnp.abs(g), axis=1)
@@ -2210,6 +2219,11 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
         hist_stats: Dict[str, object] = {
             "grow_policy": grow_policy, "hist_quant": "off",
             "hist_shard": shard_mode,
+            # raw-score carry (and therefore the per-round grad/hess
+            # recompute) placement: row-sharded over dp in data-parallel
+            # fits, replicated/serial otherwise
+            "grad_shard": ("dp" if (mesh is not None and not feature_mode)
+                           else "off"),
             "efb_bundles": 0, "efb_bundled_features": 0}
         if mesh is not None and shard_reason is not None:
             hist_stats["hist_shard_reason"] = shard_reason
@@ -2264,15 +2278,20 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
     else:
         group_layout = None
 
-    # raw scores, (N,) or (N,K)
+    # raw scores, (N,) or (N,K) — placed like the rows they score: in
+    # data-parallel fits the carry is sharded over dp so each round's
+    # grad/hess recompute stays on the replica owning the rows and
+    # feeds the sharded histogram builder without a gather
     raw_shape = (n,) if k == 1 else (n, k)
     if init_raw is not None:
         # warm start (modelString continuation, LightGBMBase.scala:48-51,
         # where init_raw includes the old model's base score) or
         # standalone init scores (initScoreCol)
-        raw = jnp.asarray(np.asarray(init_raw, dtype=np.float32).reshape(raw_shape))
+        raw = dev_put(np.asarray(init_raw, dtype=np.float32).reshape(
+            raw_shape), len(raw_shape))
     else:
-        raw = jnp.full(raw_shape, base_score, dtype=jnp.float32)
+        raw = dev_put(np.full(raw_shape, base_score, dtype=np.float32),
+                      len(raw_shape))
 
     valid_states = []
     for vi, vset in enumerate(valid_sets or []):

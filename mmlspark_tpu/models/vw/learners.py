@@ -525,6 +525,9 @@ class _VWBaseLearner(Estimator, _VWParams):
             seg = nb_total
         rng_order = np.random.default_rng(get("seed"))
         from mmlspark_tpu.core.timer import StopWatch
+        from mmlspark_tpu.parallel.prefetch import (BatchPrefetcher,
+                                                    resolve_prefetch_depth)
+        prefetch_async = resolve_prefetch_depth() > 0
         watch = StopWatch()
         pass_losses: List[float] = []
         # -- pass-boundary checkpoints + elastic restart ----------------
@@ -577,20 +580,36 @@ class _VWBaseLearner(Estimator, _VWParams):
                 if p < start_pass:
                     continue  # completed before the restart
                 preds_parts = []
-                for b0 in range(0, nb_total, seg):
-                    if mesh is not None and self.get("interPassSync"):
-                        # host boundary of the cross-shard weight
-                        # average (the VW spanning-tree allreduce)
-                        from mmlspark_tpu.core.faults import fault_point
-                        fault_point("allreduce")
-                    w, g2, s, n_acc, bias, t, preds = run_pass(
-                        w, g2, s, n_acc, bias, t,
-                        jnp.asarray(bidx[b0:b0 + seg]),
-                        jnp.asarray(bval[b0:b0 + seg]),
-                        jnp.asarray(by[b0:b0 + seg]),
-                        jnp.asarray(bwt[b0:b0 + seg]))
-                    if progressive and p == 0:
-                        preds_parts.append(np.asarray(preds).reshape(-1))
+
+                def pass_segments(bi=bidx, bv=bval, yy=by, ww=bwt):
+                    # bound defaults: the shuffle reassigns the outer
+                    # names each pass, and the producer thread must
+                    # keep reading THIS pass's arrays
+                    for b0 in range(0, nb_total, seg):
+                        yield (bi[b0:b0 + seg], bv[b0:b0 + seg],
+                               yy[b0:b0 + seg], ww[b0:b0 + seg])
+
+                def place_segment(segt):
+                    return tuple(jnp.asarray(a) for a in segt)
+
+                # one prefetcher per pass: host slicing + the
+                # device transfer overlap the previous segment's
+                # run_pass dispatch
+                with BatchPrefetcher(pass_segments(), place_segment,
+                                     label="vw.pass") as pf:
+                    prefetch_async = prefetch_async and pf.async_mode
+                    for si, sv, sy, sw in pf:
+                        if mesh is not None and self.get("interPassSync"):
+                            # host boundary of the cross-shard weight
+                            # average (the VW spanning-tree allreduce)
+                            from mmlspark_tpu.core.faults import \
+                                fault_point
+                            fault_point("allreduce")
+                        w, g2, s, n_acc, bias, t, preds = run_pass(
+                            w, g2, s, n_acc, bias, t, si, sv, sy, sw)
+                        if progressive and p == 0:
+                            preds_parts.append(
+                                np.asarray(preds).reshape(-1))
                 if progressive and p == 0:
                     all_preds = np.concatenate(preds_parts)[:len(y)]
                 pass_losses.append(self._train_loss(
@@ -631,6 +650,7 @@ class _VWBaseLearner(Estimator, _VWParams):
                 "avgTrainLossPerPass": pass_losses,
                 "trainSeconds": watch.elapsed,
                 "syncsPerPass": int((nb_total + seg - 1) // seg),
+                "prefetch": "on" if prefetch_async else "off",
             },
         }
         return state, (np.asarray(all_preds) if progressive else None)

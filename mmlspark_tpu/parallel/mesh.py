@@ -290,3 +290,11 @@ def row_sharded(mesh, ndim: int = 1, axis: str = DATA_AXIS):
     spec = [None] * ndim
     spec[0] = axis
     return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(*spec))
+
+
+def named_sharding(mesh, *spec):
+    """NamedSharding from positional PartitionSpec entries — the
+    train/prefetch loops build ad-hoc placements often enough that the
+    two-class ceremony deserves one helper."""
+    import jax
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(*spec))

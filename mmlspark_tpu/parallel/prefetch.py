@@ -1,0 +1,165 @@
+"""Async double-buffered batch prefetch for training loops.
+
+The reference feeds Spark partitions to workers through a streaming
+micro-batch push; our fit loops were purely synchronous — each step
+waited on a host slice + ``device_put`` before dispatching.
+:class:`BatchPrefetcher` overlaps that input work with device compute
+(the TensorFlow input-pipeline argument, arXiv:1605.08695): a
+background thread pulls host batches from an iterator, places them
+on-device (``device_put`` onto ``P("dp")`` for sharded loops), and
+stages up to ``MMLSPARK_TPU_PREFETCH_DEPTH`` ready batches in a
+bounded queue while the consumer runs the current step.
+
+Honest fallback: depth 0 (or a failed thread start) degrades to the
+synchronous path — same batches, same order, no thread. The consumer's
+batch stream is bit-identical either way; only the overlap changes.
+
+Teardown contract: ``close()`` (or leaving the ``with`` block, even on
+an exception) stops the producer thread and joins it — no leaked
+threads, pinned by tests/parallel/test_train_shard.py.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+_SENTINEL_DONE = object()
+
+
+def resolve_prefetch_depth(depth: Optional[int] = None) -> int:
+    """Staged-batch budget: explicit ``depth`` wins, else the
+    MMLSPARK_TPU_PREFETCH_DEPTH knob (default 2 — double buffering).
+    0 means synchronous feeding."""
+    if depth is not None:
+        return max(int(depth), 0)
+    from mmlspark_tpu.core.env import env_int
+
+    return env_int("MMLSPARK_TPU_PREFETCH_DEPTH", 2, minimum=0)
+
+
+class BatchPrefetcher:
+    """Iterate ``source`` with ``place_fn`` applied one-or-more batches
+    ahead on a background thread.
+
+    ``source``: iterable of host batches (any value).
+    ``place_fn``: host batch -> device batch (e.g. a sharded
+    ``device_put``); identity when None.
+    ``depth``: staged-batch cap; None reads the env knob; 0 = sync.
+
+    A producer-side exception is re-raised in the consumer at the point
+    the failing batch would have been delivered, after which the
+    prefetcher is closed.
+    """
+
+    def __init__(self, source: Iterable, place_fn: Optional[Callable] = None,
+                 depth: Optional[int] = None, label: str = "prefetch"):
+        self.label = label
+        self.depth = resolve_prefetch_depth(depth)
+        self._place = place_fn if place_fn is not None else (lambda b: b)
+        self._source = iter(source)
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._closed = False
+        if self.depth > 0:
+            self._queue = queue.Queue(maxsize=self.depth)
+            self._thread = threading.Thread(
+                target=self._produce, name=f"mmlspark-{label}",
+                daemon=True)
+            self._thread.start()
+
+    @property
+    def async_mode(self) -> bool:
+        """True when a producer thread is staging batches ahead."""
+        return self._thread is not None
+
+    # -- producer ------------------------------------------------------
+
+    def _produce(self) -> None:
+        try:
+            for batch in self._source:
+                if self._stop.is_set():
+                    return
+                staged = self._place(batch)
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(staged, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                else:
+                    return
+            self._put_final(_SENTINEL_DONE)
+        except BaseException as e:  # delivered to the consumer
+            self._put_final(e)
+
+    def _put_final(self, item) -> None:
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    # -- consumer ------------------------------------------------------
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        if self._queue is None:  # synchronous fallback
+            try:
+                return self._place(next(self._source))
+            except StopIteration:
+                self.close()
+                raise
+        while True:
+            try:
+                item = self._queue.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if self._thread is not None and not self._thread.is_alive():
+                    # producer died without delivering its sentinel
+                    # (should not happen; never hang the fit on it)
+                    self.close()
+                    raise StopIteration
+        if item is _SENTINEL_DONE:
+            self.close()
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self.close()
+            raise item
+        return item
+
+    def close(self) -> None:
+        """Stop and join the producer; idempotent, exception-safe."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        if self._queue is not None:
+            # unblock a producer waiting on a full queue
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def __enter__(self) -> "BatchPrefetcher":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+    def __del__(self):  # best-effort backstop; close() is the contract
+        try:
+            self.close()
+        except Exception:
+            pass

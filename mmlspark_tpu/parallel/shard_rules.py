@@ -51,6 +51,12 @@ from mmlspark_tpu.parallel.mesh import (
 # "scalar/small leaves replicated" convention of the exemplar tables.
 SMALL_LEAF_NUMEL = 65536
 
+# Training-state tables use a lower threshold: an optimizer moment is
+# touched once per step (not once per row), so sharding pays off at
+# much smaller sizes — and the ZeRO-1 memory win must materialize on
+# test-scale models too.
+TRAIN_SMALL_LEAF_NUMEL = 4096
+
 # Per-family rule tables. Specs are tuples over the leaf's dims,
 # left-aligned like PartitionSpec; axis names must be mesh.py *_AXIS
 # constants (GL001 checks these statically). Scoring is row-parallel —
@@ -87,6 +93,24 @@ FAMILY_RULES: Dict[str, List[Tuple[str, Tuple]]] = {
     "vw": VW_RULES,
     "onnx": ONNX_RULES,
     "dl": DL_RULES,
+}
+
+# Training-state placement (ZeRO-1, arXiv:2004.13336): optimizer
+# moments and large param leaves partition over dp on the first dim
+# the axis divides; small leaves (<= TRAIN_SMALL_LEAF_NUMEL) replicate
+# via the match_partition_rules threshold. Each replica owns one shard
+# of the weight update — grads reduce-scatter into it, updated params
+# all-gather out of it (dl/estimator.py wires the constraints).
+DL_TRAIN_RULES: List[Tuple[str, Tuple]] = [
+    (r".*", (DATA_AXIS, None)),
+    (r".*", (None, DATA_AXIS)),
+    (r".*", (DATA_AXIS, None, None)),
+    (r".*", (DATA_AXIS,)),
+    (r".*", ()),
+]
+
+TRAIN_FAMILY_RULES: Dict[str, List[Tuple[str, Tuple]]] = {
+    "dl": DL_TRAIN_RULES,
 }
 
 
@@ -128,23 +152,37 @@ def _spec_fits(spec: Tuple, leaf, mesh) -> bool:
 
 
 def match_partition_rules(rules: List[Tuple[str, Tuple]], params,
-                          mesh=None, label: str = "model"):
+                          mesh=None, label: str = "model",
+                          small_numel: int = SMALL_LEAF_NUMEL):
     """Map a param pytree to a pytree of spec tuples via the rule table.
 
-    Scalars and small leaves replicate before rules apply. The first
-    rule whose regex matches the '/'-joined leaf name AND whose spec
-    fits the leaf's rank/shape on this mesh wins. A leaf no rule
-    matches falls back to replication with a ``warn_once`` naming the
-    leaf — the downgrade contract: no silent placement decisions.
+    Scalars and leaves at or below ``small_numel`` elements replicate
+    before rules apply (training-state tables pass the lower
+    TRAIN_SMALL_LEAF_NUMEL threshold). The first rule whose regex
+    matches the '/'-joined leaf name AND whose spec fits the leaf's
+    rank/shape on this mesh wins. A leaf no rule matches falls back to
+    replication with a ``warn_once`` naming the leaf — the downgrade
+    contract: no silent placement decisions.
     """
     import jax
 
+    specs = _match_rules_flat(rules, params, mesh, label, small_numel)
+    treedef = jax.tree_util.tree_structure(params)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def _match_rules_flat(rules: List[Tuple[str, Tuple]], params, mesh,
+                      label: str, small_numel: int) -> List[Tuple]:
+    """Flat spec list in ``tree_leaves(params)`` order (the tree-free
+    core of :func:`match_partition_rules`; training-state helpers use
+    it directly because optax states are namedtuples, which a
+    tuple-leaved spec pytree cannot round-trip through)."""
     named = _leaf_paths(params)
     specs = []
     for name, leaf in named:
         ndim = getattr(leaf, "ndim", 0)
         numel = int(np.prod(getattr(leaf, "shape", ()) or (1,)))
-        if ndim == 0 or numel <= SMALL_LEAF_NUMEL:
+        if ndim == 0 or numel <= small_numel:
             specs.append(())
             continue
         for pattern, spec in rules:
@@ -157,8 +195,7 @@ def match_partition_rules(rules: List[Tuple[str, Tuple]], params,
                       "%r (shape %s) on this mesh; replicating",
                       label, name, tuple(getattr(leaf, "shape", ())))
             specs.append(())
-    treedef = jax.tree_util.tree_structure(params)
-    return jax.tree_util.tree_unflatten(treedef, specs)
+    return specs
 
 
 def spec_to_pspec(spec: Tuple):
@@ -256,6 +293,89 @@ def resolve_shard_rules(mesh, label: str = "model") -> Tuple[str, str]:
                   label, DATA_AXIS)
         return "replicate", f"mesh lacks the {DATA_AXIS!r} axis"
     return "rules", f"rule table over {mesh.devices.size}-device mesh"
+
+
+def resolve_train_shard(mesh, label: str = "fit") -> Tuple[str, str]:
+    """Resolve the training-state mode from MMLSPARK_TPU_TRAIN_SHARD +
+    the fit mesh.
+
+    Returns ``(mode, reason)``: ``sharded`` (ZeRO-1 — optimizer
+    moments partitioned over dp via DL_TRAIN_RULES, grads
+    reduce-scattered, params all-gathered after the owned-shard
+    update) or ``replicated`` (the legacy fully replicated update).
+    Downgrades warn once and the pair lands in the fitted model's
+    ``shard_metadata()`` — same contract as :func:`resolve_shard_rules`.
+    """
+    from mmlspark_tpu.core.env import env_str
+
+    knob = (env_str("MMLSPARK_TPU_TRAIN_SHARD", "auto") or "auto")
+    knob = knob.strip().lower() or "auto"
+    if knob not in ("auto", "on", "off"):
+        warn_once("train_shard.knob.unknown",
+                  "MMLSPARK_TPU_TRAIN_SHARD=%r not in auto|on|off; "
+                  "using auto", knob)
+        knob = "auto"
+    if knob == "off":
+        return "replicated", "disabled by MMLSPARK_TPU_TRAIN_SHARD=off"
+    if mesh is None:
+        if knob == "on":
+            warn_once(f"train_shard.no_mesh.{label}",
+                      "MMLSPARK_TPU_TRAIN_SHARD=on but %s carries no "
+                      "mesh; training state stays replicated", label)
+            return "replicated", "requested on, but no mesh attached"
+        return "replicated", "no mesh attached"
+    if DATA_AXIS not in mesh.axis_names:
+        if knob == "on":
+            warn_once(f"train_shard.no_dp.{label}",
+                      "MMLSPARK_TPU_TRAIN_SHARD=on but the mesh for %s "
+                      "has no %r axis; training state stays replicated",
+                      label, DATA_AXIS)
+        return "replicated", f"mesh lacks the {DATA_AXIS!r} axis"
+    return "sharded", (f"ZeRO-1 over dp={axis_size(mesh, DATA_AXIS)} "
+                       f"({mesh.devices.size}-device mesh)")
+
+
+def train_state_shardings(state, mesh, label: str = "train_state",
+                          family: str = "dl"):
+    """NamedSharding pytree for a training-state pytree (params, grads,
+    or optimizer state) under the family's *_TRAIN_RULES table with the
+    training-state small-leaf threshold. Built leaf-wise (optax states
+    are namedtuples, so spec tuples cannot live as pytree leaves)."""
+    import jax
+
+    specs = _match_rules_flat(TRAIN_FAMILY_RULES[family], state, mesh,
+                              label, TRAIN_SMALL_LEAF_NUMEL)
+    shardings = [jax.sharding.NamedSharding(mesh, spec_to_pspec(s))
+                 for s in specs]
+    treedef = jax.tree_util.tree_structure(state)
+    return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def train_state_bytes_per_device(state, mesh, label: str = "train_state",
+                                 family: str = "dl") -> int:
+    """Analytic per-device bytes of ``state`` under its *_TRAIN_RULES
+    placement: sharded leaves contribute nbytes / (product of their
+    axis sizes), replicated leaves full nbytes — the optimizer-state
+    memory model the train-shard metadata and MULTICHIP row report.
+    ``mesh=None`` gives the fully replicated total."""
+    named = _leaf_paths(state)
+    specs = (_match_rules_flat(TRAIN_FAMILY_RULES[family], state, mesh,
+                               label, TRAIN_SMALL_LEAF_NUMEL)
+             if mesh is not None else [() for _ in named])
+    total = 0
+    for (_, leaf), spec in zip(named, specs):
+        nbytes = int(np.prod(getattr(leaf, "shape", ()) or (1,))
+                     * np.dtype(getattr(leaf, "dtype",
+                                        np.float32)).itemsize)
+        denom = 1
+        for entry in spec:
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            for ax in axes:
+                if ax is not None and mesh is not None \
+                        and ax in mesh.axis_names:
+                    denom *= axis_size(mesh, ax)
+        total += nbytes // denom
+    return total
 
 
 class ShardedScorer:
