@@ -300,6 +300,84 @@ def main():
     }))
 
 
+def refresh_latency_main():
+    """``python bench.py --refresh-latency``: the streaming-refresh
+    row — wall time from fresh-data arrival to the refreshed model
+    serving (warm-start refit + atomic hot-swap), with the swap's
+    serving downtime recorded separately. Steady state: one warm
+    refresh generation first, the second is timed. BENCH_REFRESH_ROWS /
+    BENCH_REFRESH_TREES override the window shape for rehearsals."""
+    platform = wait_for_backend(metric="refresh_latency", unit="s",
+                                allow_cpu_fallback=True)
+    print(f"# backend up: {platform}", file=sys.stderr, flush=True)
+    import tempfile
+
+    import jax
+
+    from mmlspark_tpu.core.compile_cache import enable_persistent_cache
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.io.refresh import RefreshController
+    from mmlspark_tpu.io.serving import ServingServer
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+
+    enable_persistent_cache()
+    rng = np.random.default_rng(0)
+    n = int(os.environ.get("BENCH_REFRESH_ROWS", 100_000))
+    trees = int(os.environ.get("BENCH_REFRESH_TREES", 30))
+    f = 28
+
+    def window(shift):
+        x = (rng.normal(size=(n, f)) + shift).astype(np.float32)
+        y = x[:, 0] - 0.5 * x[:, 1] + 0.25 * x[:, 2] * x[:, 3]
+        return x, y
+
+    est = LightGBMRegressor(numIterations=trees, numLeaves=63,
+                            maxBin=63, minDataInLeaf=20, seed=0)
+    x0, y0 = window(0.0)
+    model = est.fit(DataFrame({"features": x0, "label": y0}))
+
+    with tempfile.TemporaryDirectory() as td, \
+            ServingServer(model, max_batch_size=64,
+                          max_latency_ms=2.0) as server:
+        ctrl = RefreshController(est, model, td, server=server,
+                                 refresh_interval_s=10_000,
+                                 min_refit_rows=n)
+        # warm generation: compiles the refit step and the new plane's
+        # scoring rung, as a long-lived refresh loop would have
+        ctrl.observe(*window(0.5))
+        warm = ctrl.refresh()
+        if warm.swap_error:
+            raise RuntimeError(f"warm swap failed: {warm.swap_error}")
+        # timed generation: data arrival -> refreshed model serving
+        x1, y1 = window(1.0)
+        t0 = time.perf_counter()
+        ctrl.observe(x1, y1)
+        result = ctrl.refresh()
+        wall = time.perf_counter() - t0
+        if result.swap_error:
+            raise RuntimeError(f"timed swap failed: {result.swap_error}")
+        on_cpu = (platform == "cpu-fallback"
+                  or jax.default_backend() == "cpu")
+        intended_cpu = os.environ.get("BENCH_PLATFORM") == "cpu"
+        suffix = "_cpu_fallback" if on_cpu and not intended_cpu else ""
+        if n != 100_000 or trees != 30:
+            suffix += f"_rows{n}_trees{trees}"
+        print(json.dumps({
+            "metric": "refresh_latency" + suffix,
+            "value": round(wall, 3),
+            "unit": "s",
+            "vs_baseline": None,  # no measured external comparator yet
+            "backend": jax.default_backend(),
+            "rows": n,
+            "new_trees": trees,
+            "refit_s": round(result.refit_s, 3),
+            "swap_s": round(result.swap["swap_s"], 4),
+            "swap_downtime_s": round(result.swap["downtime_s"], 4),
+            "generation": result.generation,
+        }))
+        ctrl.close()
+
+
 def serving_sustained_main():
     """``python bench.py --serving-sustained``: the serving-path row —
     64 keep-alive clients for a fixed duration against the generic
@@ -321,5 +399,7 @@ def serving_sustained_main():
 if __name__ == "__main__":
     if "--serving-sustained" in sys.argv:
         serving_sustained_main()
+    elif "--refresh-latency" in sys.argv:
+        refresh_latency_main()
     else:
         main()

@@ -1,0 +1,163 @@
+"""Streaming refresh end to end: ingest -> drift -> warm-start refit
+-> atomic hot-swap, plus the two chaos drills that harden the loop.
+
+A served GBDT watches its input distribution through a PSI drift
+detector; when the regime shifts, the controller warm-starts a refit
+(new trees on fresh rows, resuming the old ensemble) and hot-swaps the
+serving registry with zero failed requests. The drills then prove the
+robustness claims: a refit killed mid-flight resumes from its segment
+checkpoint bitwise-identical to an unkilled run, and a corrupted swap
+rolls back with the old model still serving while ``/healthz`` walks
+ok -> degraded -> ok.
+"""
+import _common
+
+_common.setup()
+
+import json
+import tempfile
+import urllib.request
+
+import numpy as np
+
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.exploratory.drift import DriftDetector
+from mmlspark_tpu.io.refresh import RefreshController
+from mmlspark_tpu.io.serving import ServingServer, SwapFailed
+from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+
+N, F = 2_000, 8
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def make(seed, shift=0.0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(N, F)) + shift
+    y = x[:, 0] - 0.5 * x[:, 1] + 0.25 * x[:, 2] * x[:, 3] \
+        + 0.1 * rng.normal(size=N)
+    return x, y
+
+
+class _Boom(Transformer):
+    def _transform(self, df):
+        raise RuntimeError("corrupted swap payload")
+
+
+def estimator():
+    return LightGBMRegressor(numIterations=10, numLeaves=15, maxBin=31,
+                             seed=0)
+
+
+def main() -> None:
+    x, y = make(0)
+    model = estimator().fit(DataFrame({"features": x, "label": y}))
+
+    with tempfile.TemporaryDirectory() as td, \
+            ServingServer(model, max_batch_size=16,
+                          max_latency_ms=2.0) as server:
+        health_url = f"http://{server.host}:{server.port}/healthz"
+        print("healthz at start:", _get(health_url)["status"])
+
+        ctrl = RefreshController(
+            estimator(), model, td, server=server,
+            detector=DriftDetector(metric="psi", threshold=0.2,
+                                   window=1024, min_rows=256),
+            refresh_interval_s=10_000, min_refit_rows=256,
+            reference_rows=x)
+
+        # -- in-regime traffic never arms a refit ------------------------
+        ctrl.observe(*make(1))
+        assert ctrl.maybe_refresh() is None
+        print("in-regime window: no refit armed")
+
+        # -- regime shift: drift arms, warm-start refit, hot-swap --------
+        x_new, y_new = make(2, shift=2.0)
+        ctrl.observe(x_new, y_new)
+        trigger, report = ctrl.poll()
+        print(f"drift armed: psi={report.score:.3f} on feature "
+              f"{report.feature} (threshold {report.threshold})")
+        result = ctrl.maybe_refresh()
+        assert result is not None and result.swapped
+        print(f"generation {result.generation} hot-swapped: "
+              f"refit {result.refit_s:.2f}s, swap downtime "
+              f"{result.swap['downtime_s'] * 1e3:.1f}ms")
+        print("healthz after swap:", _get(health_url)["status"])
+        reply = _post(server.url, {"features": x_new[0].tolist()})
+        expected = result.model.transform(
+            DataFrame({"features": x_new[:1]}))
+        assert reply["prediction"] == float(
+            expected.col("prediction")[0])
+        print("served one row from the refreshed model")
+
+        # -- chaos drill 1: kill mid-refit, resume bitwise ---------------
+        ctrl.observe(*make(3, shift=2.0))
+        with faults.injected("gbdt.train_step", "raise", nth=4,
+                             count=1):
+            try:
+                ctrl.refresh(swap=False)
+                raise AssertionError("fault never fired")
+            except faults.FaultInjected:
+                print("killed the refit mid-segment")
+        resumed = ctrl.refresh(swap=False)
+        print(f"resumed from segment checkpoint: generation "
+              f"{resumed.generation} committed ({resumed.rows} rows)")
+
+        # the resumed model must be bitwise-identical to one trained
+        # with no kill at all
+        with tempfile.TemporaryDirectory() as td2:
+            clean_ctrl = RefreshController(
+                estimator(), result.model, td2,
+                refresh_interval_s=10_000, min_refit_rows=256)
+            clean_ctrl.observe(*make(3, shift=2.0))
+            clean = clean_ctrl.refresh(swap=False)
+        assert (resumed.model.get_model_string()
+                == clean.model.get_model_string())
+        print("resume parity: killed == unkilled, bitwise")
+
+        # -- chaos drill 2: corrupt mid-swap, rollback -------------------
+        before = _post(server.url, {"features": x_new[1].tolist()})
+        transitions = [_get(health_url)["status"]]
+
+        def corrupt(served):
+            h = _get(health_url)
+            transitions.append(f"{h['status']} ({h['reason']})")
+            served.plane = None
+            served.binned_supported = False
+            served.model = _Boom()
+            return served
+
+        with faults.injected("registry.swap", "corrupt",
+                             corrupt=corrupt):
+            try:
+                server.swap_model(
+                    server._default, resumed.model,
+                    probe_payload={"features": x_new[0].tolist()})
+                raise AssertionError("swap unexpectedly committed")
+            except SwapFailed as e:
+                print("corrupted swap rolled back:", e)
+        transitions.append(_get(health_url)["status"])
+        print("healthz transitions:", " -> ".join(transitions))
+        after = _post(server.url, {"features": x_new[1].tolist()})
+        assert after == before
+        print("old model kept serving bitwise-identical replies")
+
+        ctrl.close()
+    print("OK 07_streaming_refresh")
+
+
+if __name__ == "__main__":
+    main()

@@ -2,7 +2,8 @@
 
 Every ``MMLSPARK_TPU_*`` knob the framework reads is declared ONCE in
 the :data:`REGISTRY` below and read through the typed helpers
-(:func:`env_flag` / :func:`env_int` / :func:`env_str` / :func:`env_raw`).
+(:func:`env_flag` / :func:`env_int` / :func:`env_float` /
+:func:`env_str` / :func:`env_raw`).
 This is the single source of truth that the graftlint GL004 checker
 (tools/graftlint) reconciles against PARAMS.md and README.md, so a knob
 cannot ship undocumented and a doc row cannot outlive its code.
@@ -35,7 +36,7 @@ class EnvVar:
     """One declared knob: parse kind, default, one-line effect."""
 
     name: str
-    kind: str            # "flag" | "int" | "str"
+    kind: str            # "flag" | "int" | "float" | "str"
     default: object
     description: str
 
@@ -182,6 +183,21 @@ PREFETCH_DEPTH = register(
     "ahead of the training step on a background thread (device_put "
     "overlapped with compute); 0 disables the thread and feeds batches "
     "synchronously")
+STREAM_BUFFER = register(
+    "MMLSPARK_TPU_STREAM_BUFFER", "int", 65536,
+    "bounded ingestion-buffer capacity (rows) for the streaming "
+    "refresh loop (io/refresh.py); a full buffer blocks the producer "
+    "(backpressure) instead of growing without bound")
+REFRESH_INTERVAL_S = register(
+    "MMLSPARK_TPU_REFRESH_INTERVAL_S", "int", 300,
+    "streaming refresh loop: seconds between time-based refit checks "
+    "(a refit arms when the interval elapsed and the buffer holds "
+    "enough rows; detected drift arms one sooner)")
+DRIFT_THRESHOLD = register(
+    "MMLSPARK_TPU_DRIFT_THRESHOLD", "float", 0.2,
+    "drift-detector arm level for the max per-feature statistic "
+    "(PSI default 0.2, the standard significant-shift level; for the "
+    "ks metric pick ~0.1-0.15) — exploratory/drift.py")
 BENCH_PROBE_TIMEOUT_S = register(
     "MMLSPARK_TPU_BENCH_PROBE_TIMEOUT_S", "int", 90,
     "bench.py: seconds per TPU backend probe attempt")
@@ -242,6 +258,27 @@ def env_int(name: str, default: int, minimum: Optional[int] = None) -> int:
         value = int(v.strip())
     except ValueError:
         _warn_once(name, f"{name}={v!r} is not an integer; using "
+                         f"{default}")
+        return default
+    if minimum is not None and value < minimum:
+        _warn_once(name, f"{name}={value} is below the minimum "
+                         f"{minimum}; using {default}")
+        return default
+    return value
+
+
+def env_float(name: str, default: float,
+              minimum: Optional[float] = None) -> float:
+    """Float knob; same degradation contract as :func:`env_int` — a
+    non-numeric or below-``minimum`` value warns once and returns
+    ``default``."""
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return default
+    try:
+        value = float(v.strip())
+    except ValueError:
+        _warn_once(name, f"{name}={v!r} is not a number; using "
                          f"{default}")
         return default
     if minimum is not None and value < minimum:

@@ -67,6 +67,16 @@ KNOWN_POINTS: Dict[str, str] = {
                         "failing blob store",
     "distributed.init": "multi-process rendezvous in distributed_init "
                         "— a coordinator that is slow to come up",
+    "stream.ingest": "streaming refresh loop's bounded-buffer put "
+                     "(io/refresh.py) — a stalled or dying producer "
+                     "feeding the ingestion stream",
+    "refresh.fit": "streaming refresh loop's warm-start refit entry — "
+                   "a refit killed mid-flight (must resume from the "
+                   "latest checkpoint bitwise)",
+    "registry.swap": "serving registry's atomic model hot-swap "
+                     "(ServingServer.swap_model) — a corrupted or "
+                     "crashed swap that must roll back to the old "
+                     "model",
 }
 
 _VALID_ACTIONS = ("raise", "delay", "corrupt")

@@ -1,9 +1,13 @@
 """Responsible-AI exploratory data balance measures (reference:
-core/.../exploratory/)."""
+core/.../exploratory/) plus streaming drift detection."""
 
 from mmlspark_tpu.exploratory.balance import (AggregateBalanceMeasure,
                                               DistributionBalanceMeasure,
                                               FeatureBalanceMeasure)
+from mmlspark_tpu.exploratory.drift import (DriftDetector, DriftReport,
+                                            ReservoirWindow, ks_statistic,
+                                            psi)
 
 __all__ = ["AggregateBalanceMeasure", "DistributionBalanceMeasure",
-           "FeatureBalanceMeasure"]
+           "FeatureBalanceMeasure", "DriftDetector", "DriftReport",
+           "ReservoirWindow", "ks_statistic", "psi"]

@@ -12,10 +12,17 @@ from mmlspark_tpu.io.http import (
     HTTPTransformer,
     SimpleHTTPTransformer,
 )
+from mmlspark_tpu.io.refresh import (
+    RefreshController,
+    RefreshResult,
+    StreamBuffer,
+)
 from mmlspark_tpu.io.serving import (
     ContinuousServingServer,
+    FleetClient,
     ServingFleet,
     ServingServer,
+    SwapFailed,
     serve_continuous,
     serve_distributed,
     serve_pipeline,
@@ -59,6 +66,8 @@ from mmlspark_tpu.io.binary import (
 
 __all__ = ["HTTPTransformer", "SimpleHTTPTransformer", "HTTPResponseData",
            "ServingServer", "ServingFleet", "ContinuousServingServer",
+           "FleetClient", "SwapFailed",
+           "RefreshController", "RefreshResult", "StreamBuffer",
            "serve_pipeline", "serve_distributed", "serve_continuous",
            "CognitiveServiceTransformer", "OpenAIChatCompletion",
            "OpenAIEmbedding", "OpenAIPrompt",

@@ -1,0 +1,393 @@
+"""Chaos-hardened streaming model refresh: ingest → drift → warm-start
+refit → atomic hot-swap.
+
+The reference keeps served models fresh by re-running batch pipelines
+and re-deploying; a long-lived single-process engine needs the loop
+*inside* the process: fresh labeled rows stream into a bounded buffer,
+a drift detector decides when the served model has gone stale, a
+warm-start refit extends the model on the buffered window, and the
+serving registry flips to the new model atomically — old model serving
+until the new one has proven itself on a scored batch.
+
+Pieces, each independently chaos-tested (tests/io/test_refresh.py):
+
+  - :class:`StreamBuffer` — bounded labeled-row ingestion
+    (``MMLSPARK_TPU_STREAM_BUFFER`` rows); a full buffer **blocks the
+    producer** (backpressure) instead of growing without bound, the
+    same contract as the serving queues and
+    :class:`~mmlspark_tpu.parallel.prefetch.BatchPrefetcher`, whose
+    producer/consumer shape :meth:`RefreshController.pump` reuses for
+    background ingestion. Fault point ``stream.ingest``.
+  - :class:`~mmlspark_tpu.exploratory.drift.DriftDetector` — PSI/KS
+    over seeded reservoir windows arms a refit
+    (``MMLSPARK_TPU_DRIFT_THRESHOLD``); a time-based fallback refit
+    fires every ``MMLSPARK_TPU_REFRESH_INTERVAL_S`` seconds so a
+    slowly-rotting model refreshes even when no single feature trips
+    the detector.
+  - warm-start refit — ``fit_incremental`` on the estimator: GBDT adds
+    trees on the fresh window (resuming mid-refit kills from the
+    estimator's segment checkpoints, bitwise identical to an unkilled
+    run), VW keeps updating the same weight vector at pass boundaries.
+    Fault point ``refresh.fit``. The drained window is **retained**
+    until the refit commits, so a killed refit retries on identical
+    data.
+  - generation commit — each refreshed model persists through the
+    crash-safe checkpoint protocol (:func:`~mmlspark_tpu.core.
+    serialize.save_checkpoint`; manifest written last is the commit
+    point); a restarted controller resumes from
+    :func:`~mmlspark_tpu.core.serialize.load_latest_checkpoint`.
+  - atomic hot-swap — :meth:`~mmlspark_tpu.io.serving.ServingServer.
+    swap_model`: new plane built cold, registry pointer flipped under
+    the model lock, ``/healthz`` ``degraded`` for the window, old
+    plane evicted only after the new model scores a clean batch —
+    rollback (old model keeps serving) on any failure. Fault point
+    ``registry.swap``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.env import (REFRESH_INTERVAL_S, STREAM_BUFFER,
+                                   env_int)
+from mmlspark_tpu.core.faults import fault_point
+from mmlspark_tpu.core.logging_utils import logger
+from mmlspark_tpu.core.serialize import (load_latest_checkpoint,
+                                         load_stage, save_checkpoint,
+                                         save_stage)
+from mmlspark_tpu.exploratory.drift import DriftDetector, DriftReport
+from mmlspark_tpu.io.serving import ServingServer, SwapFailed
+from mmlspark_tpu.parallel.prefetch import BatchPrefetcher
+
+__all__ = ["StreamBuffer", "RefreshController", "RefreshResult"]
+
+
+class StreamBuffer:
+    """Bounded buffer of labeled training rows with producer
+    backpressure.
+
+    ``put`` blocks while admitting the block would exceed ``capacity``
+    rows (default ``MMLSPARK_TPU_STREAM_BUFFER``); a block larger than
+    the whole capacity is admitted only into an empty buffer (it could
+    never fit otherwise — refusing it would deadlock the producer).
+    ``drain`` hands the consumer everything buffered and wakes blocked
+    producers. Thread-safe; ``close`` unblocks every waiter."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = env_int(STREAM_BUFFER, 65536, minimum=1)
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self.capacity = int(capacity)
+        self._lock = threading.Condition()
+        self._blocks: list = []          # [(x_block, y_block), ...]
+        self._rows = 0
+        self._closed = False
+        self.total_rows = 0              # lifetime ingested
+
+    @property
+    def rows(self) -> int:
+        with self._lock:
+            return self._rows
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def put(self, x: np.ndarray, y: np.ndarray,
+            timeout: Optional[float] = None) -> bool:
+        """Buffer a labeled block; blocks under backpressure. Returns
+        False on timeout (rows NOT buffered), True when buffered.
+        Raises RuntimeError when the buffer is closed."""
+        x = np.atleast_2d(np.asarray(x, dtype=np.float64))
+        y = np.asarray(y, dtype=np.float64).ravel()
+        if len(x) != len(y):
+            raise ValueError(
+                f"features/labels row mismatch: {len(x)} vs {len(y)}")
+        # chaos boundary: a producer dying (raise) or stalling (delay)
+        # mid-ingest — the loop must keep serving and later refit on
+        # whatever DID arrive
+        fault_point("stream.ingest")
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._lock:
+            while (not self._closed and self._rows > 0
+                   and self._rows + len(x) > self.capacity):
+                if deadline is None:
+                    self._lock.wait(0.5)
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    self._lock.wait(remaining)
+            if self._closed:
+                raise RuntimeError("put() on a closed StreamBuffer")
+            self._blocks.append((x, y))
+            self._rows += len(x)
+            self.total_rows += len(x)
+            self._lock.notify_all()
+        return True
+
+    def drain(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Everything buffered as one ``(x, y)`` pair (``(0, 0)``-row
+        arrays when empty); wakes producers blocked on a full buffer."""
+        with self._lock:
+            blocks, self._blocks = self._blocks, []
+            self._rows = 0
+            self._lock.notify_all()
+        if not blocks:
+            return (np.empty((0, 0), dtype=np.float64),
+                    np.empty((0,), dtype=np.float64))
+        return (np.concatenate([b[0] for b in blocks]),
+                np.concatenate([b[1] for b in blocks]))
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+
+
+@dataclass
+class RefreshResult:
+    """One committed :meth:`RefreshController.refresh` cycle."""
+
+    generation: int
+    model: Any
+    rows: int                            # rows the refit trained on
+    trigger: str                         # drift | interval | forced
+    drift: Optional[DriftReport]
+    refit_s: float
+    swap: Optional[Dict[str, Any]] = None   # swap_model timings
+    swap_error: Optional[str] = None        # rollback reason, if any
+    total_s: float = 0.0
+
+    @property
+    def swapped(self) -> bool:
+        return self.swap is not None
+
+
+class RefreshController:
+    """Drive the ingest → drift → refit → hot-swap loop for one model.
+
+    ``estimator``: the configured estimator whose ``fit_incremental``
+    extends the served model (GBDT adds trees, VW continues the weight
+    vector). ``model``: the currently-served generation — superseded
+    on construction by a newer committed generation found in
+    ``checkpoint_dir`` (crash recovery). ``server``/``model_name``:
+    when given, every committed refresh hot-swaps the serving registry
+    via :meth:`ServingServer.swap_model` (rollback on failure leaves
+    the old model serving and is reported, not raised).
+
+    ``segment_interval`` threads through the estimator's own
+    checkpointing (trees per GBDT segment / passes per VW snapshot) so
+    a refit killed mid-flight resumes from its latest segment; the
+    drained window is retained until commit, so the retry sees
+    identical data and the resumed model is **bitwise identical** to
+    an unkilled run (tests/io/test_refresh.py pins this)."""
+
+    def __init__(self, estimator, model, checkpoint_dir: str,
+                 server: Optional[ServingServer] = None,
+                 model_name: Optional[str] = None,
+                 detector: Optional[DriftDetector] = None,
+                 buffer: Optional[StreamBuffer] = None,
+                 refresh_interval_s: Optional[float] = None,
+                 min_refit_rows: int = 256,
+                 segment_interval: int = 1,
+                 reference_rows: Optional[np.ndarray] = None):
+        self.estimator = estimator
+        self.checkpoint_dir = checkpoint_dir
+        self.server = server
+        self.model_name = model_name
+        self.detector = detector if detector is not None else DriftDetector()
+        self.buffer = buffer if buffer is not None else StreamBuffer()
+        if refresh_interval_s is None:
+            # 0 = interval trigger off (drift/forced refreshes only)
+            refresh_interval_s = env_int(REFRESH_INTERVAL_S, 300,
+                                         minimum=0)
+        self.refresh_interval_s = float(refresh_interval_s)
+        self.min_refit_rows = int(min_refit_rows)
+        self.segment_interval = int(segment_interval)
+        self.model = model
+        self.generation = 0
+        # drained-but-uncommitted window: survives a killed refit so
+        # the retry trains on the same rows (determinism contract)
+        self._pending: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self._last_refresh = time.monotonic()
+        self.stats = {"refreshes": 0, "refresh_failures": 0,
+                      "swaps": 0, "swap_failures": 0,
+                      "drift_arms": 0, "interval_arms": 0}
+        if reference_rows is not None:
+            self.detector.set_reference(reference_rows)
+        # crash recovery: the newest committed generation on disk wins
+        # over the caller's model (the caller typically passes the
+        # generation-0 fit, which a restart must not re-serve)
+        latest = load_latest_checkpoint(checkpoint_dir,
+                                        self._config_hash())
+        if latest is not None:
+            tag, state = latest
+            self.generation = int(tag)
+            self.model = load_stage(
+                os.path.join(checkpoint_dir, state["model_dir"]))
+            logger.info("refresh: resumed generation %d from %s",
+                        self.generation, checkpoint_dir)
+
+    def _config_hash(self) -> str:
+        """Stable digest of the refit configuration: a restarted
+        controller with changed estimator params must refuse the old
+        generations rather than silently continue them."""
+        items = sorted(self.estimator.simple_param_values().items())
+        return hashlib.sha256(
+            f"refresh:{type(self.estimator).__name__}:{items!r}"
+            .encode()).hexdigest()[:16]
+
+    # -- ingestion -----------------------------------------------------------
+    def observe(self, x: np.ndarray, y: np.ndarray,
+                timeout: Optional[float] = None) -> bool:
+        """Feed fresh labeled rows: buffered for the next refit and
+        absorbed into the drift detector's current window. Blocks
+        under buffer backpressure; False on timeout."""
+        ok = self.buffer.put(x, y, timeout=timeout)
+        if ok:
+            self.detector.update(np.atleast_2d(
+                np.asarray(x, dtype=np.float64)))
+        return ok
+
+    def pump(self, stream: Iterable[Tuple[np.ndarray, np.ndarray]],
+             depth: Optional[int] = None) -> int:
+        """Drain an iterable of ``(x, y)`` blocks through a bounded
+        background producer into the buffer (the input-pipeline
+        overlap of parallel/prefetch.py applied to ingestion: the
+        stream source runs ahead on its own thread, bounded by
+        ``depth`` staged blocks plus the buffer's row capacity).
+        Returns rows ingested; the producer thread is always joined
+        on exit, exceptions included."""
+        rows = 0
+        with BatchPrefetcher(stream, depth=depth,
+                             label="refresh-ingest") as staged:
+            for x, y in staged:
+                self.observe(x, y)
+                rows += len(np.atleast_2d(x))
+        return rows
+
+    # -- refresh decision ----------------------------------------------------
+    def poll(self) -> Tuple[Optional[str], DriftReport]:
+        """Should a refit run now? Returns ``(trigger, report)`` with
+        trigger ``"drift"`` | ``"interval"`` | ``None``."""
+        report = self.detector.check()
+        pending = 0 if self._pending is None else len(self._pending[0])
+        if self.buffer.rows + pending < self.min_refit_rows:
+            return None, report
+        if report.drifted:
+            return "drift", report
+        # 0 = interval trigger off (the checkpointInterval convention):
+        # drift and forced refreshes only
+        if (self.refresh_interval_s > 0
+                and time.monotonic() - self._last_refresh
+                >= self.refresh_interval_s):
+            return "interval", report
+        return None, report
+
+    def maybe_refresh(self, swap: bool = True) -> Optional[RefreshResult]:
+        """One loop tick: refit + hot-swap iff armed; None otherwise."""
+        trigger, report = self.poll()
+        if trigger is None:
+            return None
+        self.stats["drift_arms" if trigger == "drift"
+                   else "interval_arms"] += 1
+        return self.refresh(swap=swap, trigger=trigger, drift=report)
+
+    # -- refit + commit + swap -----------------------------------------------
+    def refresh(self, swap: bool = True, trigger: str = "forced",
+                drift: Optional[DriftReport] = None) -> RefreshResult:
+        """Warm-start refit on the buffered window, commit the new
+        generation, hot-swap the registry.
+
+        Kill-safety: the drained window lands in ``_pending`` before
+        the fault boundary and is only cleared at commit — a refit
+        killed anywhere in between retries on identical rows, and the
+        estimator's segment checkpoints resume its partial progress
+        (``gen_<N>_segments/``). A failed hot-swap is reported on the
+        result (``swap_error``), never raised: the old model keeps
+        serving, which is the rollback contract."""
+        t0 = time.monotonic()
+        x, y = self.buffer.drain()
+        if self._pending is not None:
+            px, py = self._pending
+            if len(x):
+                x = np.concatenate([px, x])
+                y = np.concatenate([py, y])
+            else:
+                x, y = px, py
+        if len(x) == 0:
+            raise RuntimeError(
+                "refresh() with an empty window: observe()/pump() rows "
+                "first (or lower min_refit_rows and use maybe_refresh)")
+        self._pending = (x, y)
+        gen = self.generation + 1
+        seg_dir = os.path.join(self.checkpoint_dir,
+                               f"gen_{gen:08d}_segments")
+        try:
+            # chaos boundary: the refit killed at entry (raise) or fed
+            # a mangled window (corrupt) — retried refits must resume
+            # deterministically
+            fault_point("refresh.fit")
+            df = DataFrame({
+                self.estimator.get("featuresCol"): x,
+                self.estimator.get("labelCol"): y})
+            new_model = self.estimator.fit_incremental(
+                df, base_model=self.model,
+                checkpoint_dir=seg_dir,
+                checkpoint_interval=self.segment_interval)
+        except Exception:
+            self.stats["refresh_failures"] += 1
+            raise
+        refit_s = time.monotonic() - t0
+        # generation commit: stage dir first, crash-safe manifest last
+        # (the save_checkpoint manifest is the commit point — a kill
+        # between the two leaves the generation invisible and the
+        # retry rewrites it)
+        model_dir = f"gen_{gen:08d}_model"
+        save_stage(new_model,
+                   os.path.join(self.checkpoint_dir, model_dir))
+        save_checkpoint(self.checkpoint_dir, gen,
+                        {"model_dir": model_dir, "rows": int(len(x)),
+                         "trigger": trigger},
+                        self._config_hash())
+        self.model = new_model
+        self.generation = gen
+        self._pending = None
+        self._last_refresh = time.monotonic()
+        self.detector.promote()
+        self.stats["refreshes"] += 1
+        result = RefreshResult(generation=gen, model=new_model,
+                               rows=int(len(x)), trigger=trigger,
+                               drift=drift, refit_s=refit_s)
+        if swap and self.server is not None:
+            name = self.model_name or self.server._default
+            # probe with a row from the refit window so eviction of the
+            # old plane is always gated on a real scored batch
+            probe = {self.estimator.get("featuresCol"): x[-1].tolist()}
+            try:
+                result.swap = self.server.swap_model(
+                    name, new_model, probe_payload=probe)
+                self.stats["swaps"] += 1
+            except SwapFailed as e:
+                self.stats["swap_failures"] += 1
+                result.swap_error = str(e)
+                logger.warning(
+                    "refresh: generation %d hot-swap rolled back, the "
+                    "previous model keeps serving (%s)", gen, e)
+        result.total_s = time.monotonic() - t0
+        return result
+
+    def close(self) -> None:
+        self.buffer.close()

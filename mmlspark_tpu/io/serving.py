@@ -53,6 +53,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.parse
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
@@ -216,6 +217,12 @@ class _BinnedPlane:
             np.asarray(self.plan.score(xb))
 
 
+class SwapFailed(RuntimeError):
+    """A :meth:`ServingServer.swap_model` that could not be committed:
+    the registry was rolled back to the previous model, which kept (and
+    keeps) serving every request."""
+
+
 class _ServedModel:
     """One registered model: its bounded queue, stats, and (while warm)
     compiled binned plane."""
@@ -230,11 +237,16 @@ class _ServedModel:
         self.stats = {"served": 0, "errors": 0, "rejected": 0,
                       "timeouts": 0, "binned_batches": 0,
                       "generic_batches": 0, "binned_fallbacks": 0,
-                      "cold_rebuilds": 0, "evictions": 0}
+                      "cold_rebuilds": 0, "evictions": 0,
+                      "swaps": 0, "swap_rollbacks": 0}
         self.plane: Optional[_BinnedPlane] = None
         self.binned_mode = "off"            # resolved at start()
         self.binned_supported: Optional[bool] = None  # None = untried
         self.binned_reason: Optional[str] = None
+        # hot-swap probation: a just-swapped-in model is held out of
+        # the batch loop until its first verification batch scores
+        # clean (the old model is only evicted after that)
+        self.held = False
 
 
 class ServingServer:
@@ -294,8 +306,12 @@ class ServingServer:
         self._lock = threading.Condition()
         self._stop = False
         self._stats = {"served": 0, "errors": 0, "rejected": 0,
-                       "timeouts": 0}
+                       "timeouts": 0, "swaps": 0, "swap_rollbacks": 0}
         self._last_shed = 0.0  # monotonic time of the last 503
+        self._last_binned_fallback = 0.0
+        # model-name -> degradation reason while a hot-swap is running
+        # (/healthz flips degraded with this reason for the duration)
+        self._swapping: Dict[str, str] = {}
 
         server = self
 
@@ -429,6 +445,14 @@ class ServingServer:
 
     def _enqueue(self, pending: "_Pending", served: _ServedModel) -> bool:
         with self._lock:
+            # a hot-swap may have replaced this model's registry entry
+            # between routing and here; re-resolve so the request can
+            # never strand on the orphaned old queue — and drop its
+            # pre-binned row, which encodes the OLD plane's bin ids
+            live = self._models.get(served.name)
+            if live is not None and live is not served:
+                served = live
+                pending.binned = None
             if len(served.queue) >= served.max_queue:
                 self._stats["rejected"] += 1
                 served.stats["rejected"] += 1
@@ -469,21 +493,37 @@ class ServingServer:
                            for name, m in self._models.items()}}
 
     def _health(self) -> Dict[str, Any]:
-        """/healthz payload: ``degraded`` while the pending queues sit
-        at half capacity or load was shed in the last 5 s — scrapers
-        and fleet registries can steer traffic away before hard 503s
-        dominate, and the flag clears once the backlog drains."""
+        """/healthz payload: top-level ``status: ok|degraded`` plus a
+        human-readable ``reason``. Degraded while a model hot-swap is
+        in progress (``swap-in-progress``), while the pending queues
+        sit at half capacity (``queue-saturated``), while load was shed
+        in the last 5 s (``load-shed``), or right after a compiled
+        binned plane fell back to generic scoring
+        (``binned-fallback``) — scrapers, fleet registries and
+        :class:`FleetClient` can steer traffic away before hard 503s
+        dominate, and the flag clears once the condition passes."""
         with self._lock:
             depth = sum(len(m.queue) for m in self._models.values())
             stats = dict(self._stats)
             last_shed = self._last_shed
+            last_fallback = self._last_binned_fallback
+            swapping = sorted(self._swapping)
             default = self._models[self._default]
             binned = {"mode": default.binned_mode,
                       "active": default.plane is not None,
                       "reason": default.binned_reason}
-        degraded = (depth >= max(self.max_queue // 2, 1)
-                    or (last_shed and time.monotonic() - last_shed < 5.0))
-        health = {"status": "degraded" if degraded else "ok",
+        now = time.monotonic()
+        reasons: List[str] = []
+        if swapping:
+            reasons.append("swap-in-progress: " + ", ".join(swapping))
+        if depth >= max(self.max_queue // 2, 1):
+            reasons.append("queue-saturated")
+        elif last_shed and now - last_shed < 5.0:
+            reasons.append("load-shed")
+        if last_fallback and now - last_fallback < 5.0:
+            reasons.append("binned-fallback")
+        health = {"status": "degraded" if reasons else "ok",
+                  "reason": "; ".join(reasons) if reasons else None,
                   "queueDepth": depth, "maxQueue": self.max_queue,
                   "rejectedConnections": getattr(
                       self._httpd, "rejected_connections", 0), **stats,
@@ -575,6 +615,139 @@ class ServingServer:
                     self._score([_Pending(dict(self._warmup_payload))
                                  for _ in range(b)], served)
 
+    # -- atomic hot-swap -----------------------------------------------------
+    def _probe(self, served: _ServedModel,
+               probe_payload: Optional[Dict[str, Any]]) -> None:
+        """Score one verification batch on a just-swapped-in model —
+        the condition for evicting the old one. Runs on the swapping
+        thread, outside the batch loop (no stats, no warm-LRU touch),
+        through the same plane/transform machinery production batches
+        use. Raises on any failure (NaN predictions included)."""
+        if served.plane is not None:
+            if probe_payload is not None:
+                rows = [served.plane.bin_row(dict(probe_payload))]
+            else:
+                # bin 0 is the always-valid missing sentinel, so a
+                # zero row exercises the full compiled path
+                rows = [np.zeros(served.plane.plan.num_features,
+                                 dtype=served.plane.plan.ingest_dtype)]
+            cols = served.plane.score_rows(rows)
+        elif probe_payload is not None:
+            df = DataFrame.from_rows([dict(probe_payload)])
+            out = served.model.transform(df)
+            cols = {c: out.col(c) for c in out.columns
+                    if c not in df.columns} or \
+                {c: out.col(c) for c in out.columns}
+        else:
+            warn_once(
+                f"serving.swap_probe.{served.name}",
+                "swap_model(%r) has no binned plane and no "
+                "probe_payload; committing the swap WITHOUT a "
+                "verification batch", served.name)
+            return
+        sanitizer.check_finite("serving.score", cols)
+
+    def swap_model(self, name: str, model: Transformer,
+                   probe_payload: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
+        """Atomically replace served model ``name`` with ``model``.
+
+        The streaming-refresh commit point (the consistent in-place
+        update of arXiv:1605.08695 §4.2 applied to the registry):
+
+          1. the new binned plane is built and pre-warmed **cold** —
+             the old model keeps serving every request while XLA
+             compiles;
+          2. the registry pointer flips under the model lock; pending
+             requests migrate to the new model's queue (their
+             pre-binned rows are dropped — the new binning owns them)
+             but stay **held** out of the batch loop;
+          3. a verification batch (``probe_payload``, or a zero-row
+             probe through the compiled plane) must score clean; only
+             then is the old plane evicted and the queue released;
+          4. any failure in 1–3 **rolls back**: the old model is
+             restored with every queued request intact, and
+             :class:`SwapFailed` is raised.
+
+        ``/healthz`` reports ``degraded`` with reason
+        ``swap-in-progress`` for the whole window. Returns
+        ``{"model", "swap_s", "downtime_s"}`` — ``downtime_s`` is the
+        flip→release window during which requests queue (or shed at
+        the bounded-queue 503 limit) rather than score."""
+        with self._lock:
+            if name not in self._models:
+                raise KeyError(
+                    f"swap_model: {name!r} is not a served model "
+                    f"(have {sorted(self._models)}); the swap API "
+                    "replaces models, it does not add them")
+            old = self._models[name]
+            if self._swapping.get(name):
+                raise SwapFailed(
+                    f"a swap of {name!r} is already in progress")
+            self._swapping[name] = "swap-in-progress"
+        t0 = time.monotonic()
+        t_flip = None
+        new = _ServedModel(name, model, old.max_queue,
+                           self._consumes_id_column(model))
+        # serving-continuity: health counters survive the swap (a
+        # scraper must not see served/errors reset mid-run)
+        new.stats = dict(old.stats)
+        new.binned_mode = old.binned_mode
+        new.held = True
+        flipped = False
+        try:
+            # 1. build + warm the compiled plane cold
+            self._ensure_plane(new)
+            # chaos boundary: a raise here is a crash before the flip
+            # (nothing to undo); a corrupt mangles the built plane /
+            # model, which the verification batch below must catch
+            new = fault_point("registry.swap", new)
+            # 2. flip under the model lock
+            with self._lock:
+                new.queue = old.queue
+                old.queue = []
+                for p in new.queue:
+                    p.binned = None  # old-plane bin ids are invalid
+                self._models[name] = new
+                if self.model is old.model:
+                    self.model = model
+                flipped = True
+            t_flip = time.monotonic()
+            # 3. probation: first scored batch on the new plane
+            self._probe(new, probe_payload)
+        except Exception as e:
+            # 4. rollback: the old model serves on, queue intact
+            with self._lock:
+                if flipped:
+                    old.queue = new.queue
+                    for p in old.queue:
+                        p.binned = None
+                    self._models[name] = old
+                    if self.model is model:
+                        self.model = old.model
+                self._swapping.pop(name, None)
+                self._stats["swap_rollbacks"] += 1
+                old.stats["swap_rollbacks"] += 1
+                self._lock.notify_all()
+            raise SwapFailed(
+                f"swap of model {name!r} failed and was rolled back; "
+                f"the previous model keeps serving ({type(e).__name__}:"
+                f" {e})") from e
+        # commit: evict the old plane only now, release the queue
+        with self._lock:
+            new.held = False
+            new.stats["swaps"] += 1
+            self._swapping.pop(name, None)
+            self._stats["swaps"] += 1
+            self._lock.notify_all()
+        old.plane = None
+        booster = getattr(old.model, "booster", None)
+        if booster is not None and hasattr(booster, "clear_jit_cache"):
+            booster.clear_jit_cache()
+        now = time.monotonic()
+        return {"model": name, "swap_s": now - t0,
+                "downtime_s": now - (t_flip if t_flip else now)}
+
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ServingServer":
         self._warm_start()
@@ -617,7 +790,10 @@ class ServingServer:
         n = len(self._model_names)
         for i in range(n):
             served = self._models[self._model_names[(self._rr + i) % n]]
-            if served.queue:
+            if served.queue and not served.held:
+                # held = swap probation: requests wait until the new
+                # model's verification batch scored clean (or the swap
+                # rolled back), never scored on an unverified model
                 self._rr = (self._rr + i + 1) % n
                 return served
         return None
@@ -709,6 +885,7 @@ class ServingServer:
         else:
             if plane is not None:
                 served.stats["binned_fallbacks"] += 1
+                self._last_binned_fallback = time.monotonic()
             df = DataFrame.from_rows([p.payload for p in batch])
             out = served.model.transform(df)
             reply_cols = [self.reply_col] if self.reply_col else \
@@ -879,18 +1056,32 @@ class FleetClient:
     # a floor every score() would re-add it and pay a failed attempt
     _min_refresh_gap_s = 1.0
 
+    # a worker marked degraded leaves rotation for this long; after
+    # that it is retried (swaps and queue spikes are transient, and the
+    # next health poll re-marks it if it still reports degraded)
+    _degraded_ttl_s = 5.0
+    # floor between /healthz sweeps when route_around_degraded is on
+    _health_poll_interval_s = 2.0
+
     def __init__(self, registry_url: str, timeout: float = 15.0,
                  retries_per_worker: int = 1,
-                 refresh_interval_s: float = 30.0):
+                 refresh_interval_s: float = 30.0,
+                 route_around_degraded: bool = False):
         self.registry_url = registry_url
         self.timeout = timeout
         self.retries_per_worker = retries_per_worker
         self.refresh_interval_s = refresh_interval_s
+        # /healthz-aware routing: periodically sweep worker health and
+        # skip workers reporting status != ok (mid-swap, saturated
+        # queue) while any healthy worker remains
+        self.route_around_degraded = route_around_degraded
         self._workers: List[str] = []
         self._next = 0
         self._lock = threading.Lock()
         self._registry_count = 0
         self._last_refresh = 0.0
+        self._degraded: Dict[str, float] = {}  # url -> marked time
+        self._last_health_poll = 0.0
 
     def refresh(self) -> List[str]:
         import urllib.request
@@ -903,10 +1094,62 @@ class FleetClient:
             self._last_refresh = time.monotonic()
         return list(workers)
 
+    @staticmethod
+    def _healthz_url(worker_url: str) -> str:
+        # worker addresses include the api path (".../score"); health
+        # lives at the server root
+        parts = urllib.parse.urlsplit(worker_url)
+        return f"{parts.scheme}://{parts.netloc}/healthz"
+
+    def worker_health(self) -> Dict[str, Dict[str, Any]]:
+        """Poll every known worker's ``/healthz``. Returns
+        ``{worker_url: health_json}`` with an
+        ``{"status": "unreachable", "reason": ...}`` stub for workers
+        that do not answer, and records non-``ok`` workers so
+        :meth:`score` routes around them (``route_around_degraded``)."""
+        import urllib.request
+        with self._lock:
+            workers = list(self._workers)
+        out: Dict[str, Dict[str, Any]] = {}
+        for url in workers:
+            try:
+                with urllib.request.urlopen(
+                        self._healthz_url(url), timeout=self.timeout) as r:
+                    health = json.loads(r.read())
+            except Exception as e:
+                health = {"status": "unreachable",
+                          "reason": f"{type(e).__name__}: {e}"}
+            out[url] = health
+            with self._lock:
+                if health.get("status") != "ok":
+                    self._degraded[url] = time.monotonic()
+                else:
+                    self._degraded.pop(url, None)
+        return out
+
+    def _maybe_poll_health(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            due = (now - self._last_health_poll
+                   >= self._health_poll_interval_s)
+            if due:
+                self._last_health_poll = now
+        if due:
+            self.worker_health()
+
     def _pick(self) -> Optional[str]:
         with self._lock:
             if not self._workers:
                 return None
+            now = time.monotonic()
+            for _ in range(len(self._workers)):
+                url = self._workers[self._next % len(self._workers)]
+                self._next += 1
+                marked = self._degraded.get(url)
+                if marked is None or now - marked > self._degraded_ttl_s:
+                    return url
+            # every worker is degraded: degraded service beats none —
+            # fall back to plain round-robin
             url = self._workers[self._next % len(self._workers)]
             self._next += 1
             return url
@@ -934,6 +1177,8 @@ class FleetClient:
             self.refresh()
         else:
             self._maybe_refresh()
+        if self.route_around_degraded:
+            self._maybe_poll_health()
         n = max(len(self._workers), 1)
         attempts = max(n * self.retries_per_worker, 1)
         last: Optional[Exception] = None

@@ -44,7 +44,8 @@ from mmlspark_tpu.core.param import (
 from mmlspark_tpu.core.pipeline import Estimator, Model
 from mmlspark_tpu.core.timer import InstrumentationMeasures
 from mmlspark_tpu.models.gbdt.booster import BoosterArrays
-from mmlspark_tpu.models.gbdt.trainer import TrainConfig, train
+from mmlspark_tpu.models.gbdt.trainer import (TrainConfig, train,
+                                              warm_start_scores)
 from mmlspark_tpu.ops.binning import BinMapper
 
 
@@ -348,6 +349,37 @@ class _LightGBMBase(Estimator, _LightGBMParams):
         self._mesh = mesh
         return self
 
+    def fit_incremental(self, df: DataFrame, base_model=None,
+                        num_new_trees: Optional[int] = None,
+                        checkpoint_dir: Optional[str] = None,
+                        checkpoint_interval: Optional[int] = None):
+        """Warm-start refit: continue ``base_model`` with new trees fit
+        on ``df`` (the streaming-refresh entry point; the reference's
+        modelString warm start, LightGBMBase.scala:45-60, as a method).
+
+        ``base_model``: a fitted model of this estimator's type whose
+        ensemble the refit extends (``None`` = fit from scratch, still
+        honoring the checkpoint args). ``num_new_trees`` overrides
+        ``numIterations`` for the *added* trees. ``checkpoint_dir`` +
+        ``checkpoint_interval`` thread through the estimator's elastic
+        checkpointing: a refit killed mid-flight and re-run resumes
+        from the latest ``checkpoint_N.txt`` segment bitwise
+        (tests/io/test_refresh.py pins this). The estimator itself is
+        not mutated — overrides ride a :meth:`copy`."""
+        overrides: Dict[str, Any] = {}
+        if base_model is not None:
+            if base_model.booster is None:
+                raise ValueError("fit_incremental: base_model has no "
+                                 "fitted booster")
+            overrides["modelString"] = base_model.get_model_string()
+        if num_new_trees is not None:
+            overrides["numIterations"] = num_new_trees
+        if checkpoint_dir is not None:
+            overrides["checkpointDir"] = checkpoint_dir
+            overrides["checkpointInterval"] = (checkpoint_interval
+                                               or 1)
+        return self.copy(**overrides).fit(df)
+
     def _extract(self, df: DataFrame):
         x = np.asarray(df.col(self.get("featuresCol")), dtype=np.float64)
         if x.ndim != 2:
@@ -454,15 +486,7 @@ class _LightGBMBase(Estimator, _LightGBMParams):
                     f"(N, {k_out}) per-class scores for a {k_out}-class "
                     f"objective; got shape {init0.shape}")
 
-        def init_scores(model, xs, offset=None):
-            # raw-space warm-start scores: computed on raw features so a
-            # continued model is valid even under a different binning,
-            # plus the optional initScoreCol per-row offset
-            s = None if model is None else np.asarray(
-                model.predict_jit()(xs))
-            if offset is not None:
-                s = offset if s is None else s + offset
-            return s
+        init_scores = warm_start_scores
 
         vx_raw = None
         if valid_sets is not None:
@@ -535,7 +559,7 @@ class _LightGBMBase(Estimator, _LightGBMParams):
             # would otherwise silently continue an incompatible model).
             fprint = self._checkpoint_fingerprint(
                 cfg, binned, y, w, mapper.bin_upper_values(cfg.max_bin),
-                init0)
+                init0, init_model)
             meta_path = os.path.join(ckpt_dir, "checkpoint_meta.json")
             if latest is not None and os.path.exists(meta_path):
                 with open(meta_path) as fh:
@@ -621,12 +645,16 @@ class _LightGBMBase(Estimator, _LightGBMParams):
         return result, mapper, measures
 
     @staticmethod
-    def _checkpoint_fingerprint(cfg, binned, y, w, bin_upper, init0=None):
+    def _checkpoint_fingerprint(cfg, binned, y, w, bin_upper, init0=None,
+                                init_model=None):
         """Digest of everything a warm start must agree on.
 
         ``num_iterations`` is deliberately excluded: resuming with a
         raised iteration budget is the supported elastic-restart path
-        (guarded separately by the done>total check).
+        (guarded separately by the done>total check). ``init_model``
+        (the modelString warm-start base, fit_incremental) IS included:
+        a checkpointed refit resumed against a different base model
+        would otherwise silently continue an incompatible ensemble.
         """
         import hashlib
         from dataclasses import asdict
@@ -634,6 +662,8 @@ class _LightGBMBase(Estimator, _LightGBMParams):
         cfg_items = {k: v for k, v in sorted(asdict(cfg).items())
                      if k != "num_iterations"}
         h = hashlib.sha256(repr(cfg_items).encode())
+        if init_model is not None:
+            h.update(init_model.save_model_string().encode())
         h.update(repr(binned.shape).encode())
         # cheap data digest: corner slices + moments, not a full pass
         head = np.ascontiguousarray(binned[:64])

@@ -2046,6 +2046,26 @@ class TrainResult:
     hist_stats: Dict[str, object] = field(default_factory=dict)
 
 
+def warm_start_scores(init_model: Optional[BoosterArrays],
+                      x: np.ndarray,
+                      offset: Optional[np.ndarray] = None
+                      ) -> Optional[np.ndarray]:
+    """Raw-space warm-start margins for continuing a fit on fresh data.
+
+    A continued booster needs the previous ensemble's margin as
+    ``train(init_raw=)``; computing it on the **raw** features (not bin
+    ids) keeps the warm start valid even when the new data is binned
+    differently — which is exactly the streaming-refresh case, where
+    each refit re-fits its BinMapper on the fresh window. ``offset``
+    is the optional per-row initScoreCol contribution. Returns ``None``
+    when there is nothing to warm-start from (both args None)."""
+    s = None if init_model is None else np.asarray(
+        init_model.predict_jit()(x))
+    if offset is not None:
+        s = offset if s is None else s + offset
+    return s
+
+
 def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
           weights: Optional[np.ndarray] = None,
           group_ids: Optional[np.ndarray] = None,
@@ -2343,8 +2363,16 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
                 binned_hist=binned_hist_d, efb_plan=efb_plan)
     finally:
         # the loops drain every dispatched step before returning
-        # (block_until_ready / eager device_get), so no histogram
-        # callback can run after this release
+        # (block_until_ready / eager device_get) — except when a step
+        # raised (fault injection, preemption): a histogram callback
+        # still in flight then must not outlive its token, or it fails
+        # with a spurious "token not registered" when the runtime
+        # blocks on outstanding effects at interpreter exit
+        if host_tokens:
+            try:
+                jax.effects_barrier()
+            except Exception:
+                pass  # a poisoned step must not mask the real error
         for tok in host_tokens:
             _release_host_binned(tok)
     trees_sf, trees_tb, trees_nv, trees_cnt, trees_dt, trees_bgl = trees

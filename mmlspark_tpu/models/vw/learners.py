@@ -707,6 +707,32 @@ class _VWBaseLearner(Estimator, _VWParams):
         self._initial_model = model
         return self
 
+    def fit_incremental(self, df: DataFrame, base_model=None,
+                        num_passes: Optional[int] = None,
+                        checkpoint_dir: Optional[str] = None,
+                        checkpoint_interval: Optional[int] = None):
+        """Online warm-start refit: continue ``base_model``'s weights
+        and optimizer state with more passes over ``df`` (the streaming
+        -refresh entry point — the GBDT twin adds trees, the online
+        learner keeps updating the same weight vector).
+
+        ``num_passes`` overrides ``numPasses`` for this refit;
+        ``checkpoint_dir`` + ``checkpoint_interval`` thread through the
+        pass-boundary checkpointing, so a refit killed mid-flight and
+        re-run resumes from the latest checkpointed pass. The learner
+        itself is not mutated — overrides ride a :meth:`copy`."""
+        overrides: Dict[str, Any] = {}
+        if num_passes is not None:
+            overrides["numPasses"] = num_passes
+        if checkpoint_dir is not None:
+            overrides["checkpointDir"] = checkpoint_dir
+            overrides["checkpointInterval"] = (checkpoint_interval
+                                               or 1)
+        est = self.copy(**overrides)
+        if base_model is not None:
+            est.set_initial_model(base_model)
+        return est.fit(df)
+
     def _make_model(self, model_cls, state):
         model = model_cls(**{k: v for k, v in self._paramMap.items()
                              if model_cls.has_param(k)})

@@ -7,8 +7,9 @@ import warnings
 import pytest
 
 from mmlspark_tpu.core import env as env_mod
-from mmlspark_tpu.core.env import (REGISTRY, env_flag, env_int,
-                                   env_override, env_raw, env_str)
+from mmlspark_tpu.core.env import (REGISTRY, env_flag, env_float,
+                                   env_int, env_override, env_raw,
+                                   env_str)
 
 VAR = "MMLSPARK_TPU_TEST_ONLY_KNOB"
 
@@ -57,6 +58,19 @@ def test_env_int(monkeypatch):
         assert env_int(VAR, 7, minimum=1) == 7
 
 
+def test_env_float(monkeypatch):
+    assert env_float(VAR, 0.2) == 0.2
+    monkeypatch.setenv(VAR, " 0.35 ")
+    assert env_float(VAR, 0.2) == 0.35
+    monkeypatch.setenv(VAR, "lots")
+    with pytest.warns(UserWarning, match="not a number"):
+        assert env_float(VAR, 0.2) == 0.2
+    env_mod.reset_warnings()
+    monkeypatch.setenv(VAR, "-0.5")
+    with pytest.warns(UserWarning, match="below the minimum"):
+        assert env_float(VAR, 0.2, minimum=0.0) == 0.2
+
+
 def test_env_str_and_raw(monkeypatch):
     assert env_str(VAR) is None
     assert env_str(VAR, "d") == "d"
@@ -95,7 +109,7 @@ def test_registry_shape():
     for name, var in REGISTRY.items():
         assert name.startswith("MMLSPARK_TPU_")
         assert var.name == name
-        assert var.kind in ("flag", "int", "str")
+        assert var.kind in ("flag", "int", "float", "str")
         assert var.description
     # the 5 knobs PR 3's audit found undocumented must stay declared
     for name in ("MMLSPARK_TPU_COMPILE_CACHE",

@@ -1,0 +1,352 @@
+"""Chaos tests for the streaming refresh loop: kill-mid-refit bitwise
+resume parity, corrupt-mid-swap rollback with ok→degraded→ok health,
+drift-armed refits, and bounded-buffer backpressure with clean
+teardown (no leaked producer thread)."""
+
+import json
+import threading
+import time
+import urllib.request as urllib_request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.faults import FaultInjected
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.exploratory.drift import DriftDetector
+from mmlspark_tpu.io.refresh import RefreshController, StreamBuffer
+from mmlspark_tpu.io.serving import ServingServer, SwapFailed
+from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+
+pytestmark = pytest.mark.refresh_smoke
+
+N, F = 600, 6
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _make_data(seed, n=N, shift=0.0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, F)) + shift
+    y = x[:, 0] - 0.5 * x[:, 1] + 0.25 * x[:, 2] * x[:, 3] \
+        + rng.normal(size=n) * 0.1
+    return x, y
+
+
+def _estimator():
+    return LightGBMRegressor(numIterations=6, numLeaves=7, maxBin=15,
+                             seed=0)
+
+
+@pytest.fixture(scope="module")
+def base():
+    x, y = _make_data(0)
+    model = _estimator().fit(DataFrame({"features": x, "label": y}))
+    return model, x, y
+
+
+def _get(url, timeout=10):
+    with urllib_request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post(url, payload, timeout=30):
+    req = urllib_request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib_request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# kill mid-refit -> resume from checkpoint, bitwise-identical
+# ---------------------------------------------------------------------------
+
+def _run_refresh(base_model, ckpt_dir, kill=None):
+    """One controller refresh over a fixed fresh window; ``kill``
+    arms a fault before the (first) refresh call, which is then
+    retried once after the injected death."""
+    ctrl = RefreshController(_estimator(), base_model, str(ckpt_dir),
+                             refresh_interval_s=10_000,
+                             min_refit_rows=32, segment_interval=2)
+    x, y = _make_data(1, shift=0.5)
+    ctrl.observe(x, y)
+    if kill is not None:
+        point, nth = kill
+        faults.arm(point, "raise", nth=nth, count=1)
+        with pytest.raises(Exception):
+            ctrl.refresh(swap=False)
+        faults.disarm(point)
+        # retry: pending window retained, segment checkpoints resumed
+    result = ctrl.refresh(swap=False)
+    assert result.generation == 1
+    assert result.rows == N
+    return result.model
+
+
+def test_kill_at_refit_entry_resumes_bitwise(base, tmp_path):
+    model, _, _ = base
+    clean = _run_refresh(model, tmp_path / "clean")
+    killed = _run_refresh(model, tmp_path / "killed",
+                          kill=("refresh.fit", 1))
+    assert killed.get_model_string() == clean.get_model_string()
+
+
+def test_kill_mid_refit_resumes_bitwise(base, tmp_path):
+    # gbdt.train_step hit 4 = second warm-started segment (segments of
+    # 2 trees): the refit dies AFTER checkpoint_2.txt committed, so the
+    # retry resumes mid-ensemble — the strongest parity claim
+    model, _, _ = base
+    clean = _run_refresh(model, tmp_path / "clean")
+    killed = _run_refresh(model, tmp_path / "killed",
+                          kill=("gbdt.train_step", 4))
+    seg_dir = tmp_path / "killed" / "gen_00000001_segments"
+    assert (seg_dir / "checkpoint_2.txt").exists()
+    assert killed.get_model_string() == clean.get_model_string()
+
+
+def test_controller_restart_resumes_committed_generation(base, tmp_path):
+    model, _, _ = base
+    refreshed = _run_refresh(model, tmp_path / "gens")
+    # a process restart constructs a fresh controller with the
+    # generation-0 model; the committed generation on disk must win
+    ctrl2 = RefreshController(_estimator(), model,
+                              str(tmp_path / "gens"),
+                              refresh_interval_s=10_000)
+    assert ctrl2.generation == 1
+    assert (ctrl2.model.get_model_string()
+            == refreshed.get_model_string())
+
+
+# ---------------------------------------------------------------------------
+# corrupt mid-swap -> rollback, old model serves, health ok->degraded->ok
+# ---------------------------------------------------------------------------
+
+class _Boom(Transformer):
+    def _transform(self, df):
+        raise RuntimeError("corrupted swap payload")
+
+
+def test_corrupt_mid_swap_rolls_back(base):
+    model, x, _ = base
+    x2, y2 = _make_data(2, shift=0.5)
+    new_model = _estimator().fit(
+        DataFrame({"features": x2, "label": y2}))
+    probe = {"features": x[0].tolist()}
+    with ServingServer(model, max_batch_size=8,
+                       max_latency_ms=2.0) as server:
+        health_url = f"http://{server.host}:{server.port}/healthz"
+        assert _get(health_url)["status"] == "ok"
+        before = _post(server.url, {"features": x[0].tolist()})
+        mid_swap_health = []
+
+        def corrupt(served):
+            # runs inside the swap window: /healthz must already be
+            # degraded with the swap-in-progress reason
+            mid_swap_health.append(_get(health_url))
+            served.plane = None
+            served.binned_supported = False
+            served.model = _Boom()
+            return served
+
+        with faults.injected("registry.swap", "corrupt",
+                             corrupt=corrupt):
+            with pytest.raises(SwapFailed):
+                server.swap_model(server._default, new_model,
+                                  probe_payload=probe)
+        assert mid_swap_health, "corrupt fault point never hit"
+        assert mid_swap_health[0]["status"] == "degraded"
+        assert "swap-in-progress" in mid_swap_health[0]["reason"]
+        # rollback: health recovers, the OLD model keeps serving with
+        # bitwise-identical replies, and the rollback is counted
+        health = _get(health_url)
+        assert health["status"] == "ok"
+        assert health["swap_rollbacks"] == 1
+        after = _post(server.url, {"features": x[0].tolist()})
+        assert after == before
+
+
+def test_swap_commits_and_serves_new_model(base):
+    model, x, _ = base
+    x2, y2 = _make_data(2, shift=0.5)
+    new_model = _estimator().fit(
+        DataFrame({"features": x2, "label": y2}))
+    with ServingServer(model, max_batch_size=8,
+                       max_latency_ms=2.0) as server:
+        health_url = f"http://{server.host}:{server.port}/healthz"
+        timing = server.swap_model(
+            server._default, new_model,
+            probe_payload={"features": x[0].tolist()})
+        assert timing["swap_s"] >= timing["downtime_s"] >= 0.0
+        assert _get(health_url)["status"] == "ok"
+        assert _get(health_url)["swaps"] == 1
+        reply = _post(server.url, {"features": x[1].tolist()})
+        expected = new_model.transform(
+            DataFrame({"features": x[1:2]}))
+        assert reply["prediction"] == float(
+            expected.col("prediction")[0])
+
+
+# ---------------------------------------------------------------------------
+# drift detection arms the refit; controller swaps the registry
+# ---------------------------------------------------------------------------
+
+def test_drift_arms_refit_and_hot_swaps(base, tmp_path):
+    model, x, _ = base
+    with ServingServer(model, max_batch_size=8,
+                       max_latency_ms=2.0) as server:
+        detector = DriftDetector(metric="psi", threshold=0.2,
+                                 window=512, min_rows=64)
+        ctrl = RefreshController(
+            _estimator(), model, str(tmp_path), server=server,
+            detector=detector, refresh_interval_s=10_000,
+            min_refit_rows=64, reference_rows=x)
+        # in-regime rows must NOT arm
+        x_same, y_same = _make_data(3)
+        ctrl.observe(x_same, y_same)
+        trigger, report = ctrl.poll()
+        assert trigger is None and not report.drifted
+        assert ctrl.maybe_refresh() is None
+        # shifted regime arms, refits, and hot-swaps the registry
+        x_new, y_new = _make_data(4, shift=2.0)
+        ctrl.observe(x_new, y_new)
+        trigger, report = ctrl.poll()
+        assert trigger == "drift" and report.drifted
+        result = ctrl.maybe_refresh()
+        assert result is not None and result.trigger == "drift"
+        assert result.swapped and result.swap_error is None
+        assert ctrl.generation == 1
+        assert ctrl.stats["drift_arms"] == 1
+        # the registry now serves the refreshed model
+        reply = _post(server.url, {"features": x_new[0].tolist()})
+        expected = result.model.transform(
+            DataFrame({"features": x_new[:1]}))
+        assert reply["prediction"] == float(
+            expected.col("prediction")[0])
+        # promotion: the refreshed regime is the new reference
+        assert not ctrl.detector.check().drifted
+
+
+def test_controller_reports_swap_rollback(base, tmp_path):
+    model, x, _ = base
+    with ServingServer(model, max_batch_size=8,
+                       max_latency_ms=2.0) as server:
+        ctrl = RefreshController(
+            _estimator(), model, str(tmp_path), server=server,
+            refresh_interval_s=10_000, min_refit_rows=32)
+        x1, y1 = _make_data(5, shift=0.5)
+        ctrl.observe(x1, y1)
+
+        def corrupt(served):
+            served.plane = None
+            served.binned_supported = False
+            served.model = _Boom()
+            return served
+
+        with faults.injected("registry.swap", "corrupt",
+                             corrupt=corrupt):
+            result = ctrl.refresh()
+        # the refit committed (generation advanced) but the swap
+        # rolled back: old model serving, error reported not raised
+        assert result.generation == 1
+        assert not result.swapped
+        assert "rolled back" in result.swap_error
+        assert ctrl.stats["swap_failures"] == 1
+        before = _post(server.url, {"features": x[0].tolist()})
+        expected = model.transform(DataFrame({"features": x[:1]}))
+        assert before["prediction"] == float(
+            expected.col("prediction")[0])
+
+
+# ---------------------------------------------------------------------------
+# bounded-buffer backpressure: producer blocks, no unbounded growth,
+# clean close, no leaked thread
+# ---------------------------------------------------------------------------
+
+def test_stream_buffer_backpressure_and_teardown():
+    buf = StreamBuffer(capacity=64)
+    high_water = []
+    done = threading.Event()
+
+    def producer():
+        for i in range(10):
+            buf.put(np.full((32, F), float(i)), np.zeros(32))
+            high_water.append(buf.rows)
+        done.set()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    # the producer must be BLOCKED at the bound, not growing past it
+    assert not done.is_set()
+    assert buf.rows <= 64
+    total = 0
+    while not done.is_set() or buf.rows:
+        x, y = buf.drain()
+        total += len(x)
+        if not done.is_set():
+            time.sleep(0.01)
+    assert max(high_water) <= 64
+    assert total == 320
+    # deterministic arrival order despite the blocking
+    buf.close()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    with pytest.raises(RuntimeError):
+        buf.put(np.zeros((1, F)), np.zeros(1))
+
+
+def test_pump_joins_producer_thread(base, tmp_path):
+    model, _, _ = base
+    ctrl = RefreshController(_estimator(), model, str(tmp_path),
+                             buffer=StreamBuffer(capacity=4096),
+                             refresh_interval_s=10_000)
+
+    def stream():
+        for i in range(5):
+            x, y = _make_data(10 + i, n=64)
+            yield x, y
+
+    rows = ctrl.pump(stream(), depth=2)
+    assert rows == 320
+    assert ctrl.buffer.rows == 320
+    assert not [t for t in threading.enumerate()
+                if "refresh-ingest" in t.name], "leaked producer thread"
+    ctrl.close()
+
+
+def test_interval_trigger_and_zero_disables(base, tmp_path):
+    model, _, _ = base
+    x, y = _make_data(6)
+    # a tiny positive interval arms "interval" once enough rows queued
+    ctrl = RefreshController(_estimator(), model, str(tmp_path / "a"),
+                             refresh_interval_s=0.001,
+                             min_refit_rows=32)
+    ctrl.observe(x, y)
+    time.sleep(0.01)
+    assert ctrl.poll()[0] == "interval"
+    # 0 = interval trigger off (the checkpointInterval convention),
+    # however stale the model is
+    ctrl0 = RefreshController(_estimator(), model, str(tmp_path / "b"),
+                              refresh_interval_s=0, min_refit_rows=32)
+    ctrl0.observe(x, y)
+    ctrl0._last_refresh -= 1e6
+    assert ctrl0.poll()[0] is None
+
+
+def test_ingest_fault_point_fires():
+    buf = StreamBuffer(capacity=64)
+    with faults.injected("stream.ingest", "raise"):
+        with pytest.raises(FaultInjected):
+            buf.put(np.zeros((1, F)), np.zeros(1))
+    # the failed put buffered nothing; the stream stays consistent
+    assert buf.rows == 0
+    buf.put(np.zeros((1, F)), np.zeros(1))
+    assert buf.rows == 1
