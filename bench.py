@@ -78,6 +78,28 @@ def probe_backend(attempt_timeout=None):
         return False, f"backend init hang (> {attempt_timeout}s)"
 
 
+# Last backend bring-up verdict, stamped into every bench JSON row so
+# the silent TPU->CPU downgrade (rounds 1/3/5) is visible IN the
+# artifact: verdict is "ok" | "hang-at-init" | "no-devices" |
+# "init-error" (the latter three mean the row ran on the cpu fallback).
+PREFLIGHT = {"verdict": None, "detail": None}
+
+
+def classify_probe(ok, detail):
+    """Attribute a backend probe outcome: a timeout is a hang (the
+    BENCH_r05 signature), a device-discovery failure means no devices
+    behind the tunnel, anything else is an init error."""
+    if ok:
+        return "ok"
+    low = (detail or "").lower()
+    if "hang" in low or "timed out" in low or "timeout" in low:
+        return "hang-at-init"
+    if ("no devices" in low or "no visible" in low or "not_found" in low
+            or "failed to get device" in low or "unavailable" in low):
+        return "no-devices"
+    return "init-error"
+
+
 def wait_for_backend(attempt_timeout=None, backoffs=(15, 30, 60, 120, 240),
                      metric="gbdt_fit_throughput_higgs28f_2M",
                      unit="Mrow-trees/s", allow_cpu_fallback=False):
@@ -112,10 +134,12 @@ def wait_for_backend(attempt_timeout=None, backoffs=(15, 30, 60, 120, 240),
         ok, detail = probe_backend(attempt_timeout)
         if ok:
             _apply_platform_override()
+            PREFLIGHT.update(verdict="ok", detail=detail)
             return detail.split()[0]
         last = detail
         print(json.dumps({"probe_attempt": i, "error": last}),
               file=sys.stderr, flush=True)
+    PREFLIGHT.update(verdict=classify_probe(False, last), detail=last)
     if allow_cpu_fallback:
         # the tunnel being down must not zero the round again: fall
         # back to the CPU backend with the metric UNAMBIGUOUSLY
@@ -213,6 +237,7 @@ def main():
         "unit": "Mrow-trees/s",
         "vs_baseline": round(row_trees_per_s / BASELINE_MROW_TREES_S, 3),
         "backend": jax.default_backend(),
+        "backend_preflight": PREFLIGHT["verdict"],
         "hist_formulation": resolve_histogram_formulation(255, warn=False),
         "hist_subtract": resolve_subtract("serial", 255),
         "native_hist_available": native_histogram_available(),
@@ -368,6 +393,7 @@ def refresh_latency_main():
             "unit": "s",
             "vs_baseline": None,  # no measured external comparator yet
             "backend": jax.default_backend(),
+            "backend_preflight": PREFLIGHT["verdict"],
             "rows": n,
             "new_trees": trees,
             "refit_s": round(result.refit_s, 3),
@@ -376,6 +402,68 @@ def refresh_latency_main():
             "generation": result.generation,
         }))
         ctrl.close()
+
+
+def preflight_main():
+    """``python bench.py --preflight``: attribute real-backend
+    bring-up WITHOUT running a workload (ROADMAP item 2a, first
+    slice). Probes backend init in a hang-safe subprocess through the
+    shared ``core/retries`` policy, prints one ``backend_preflight``
+    JSON row with the verdict (``ok`` / ``hang-at-init`` /
+    ``no-devices`` / ``init-error`` — the non-ok verdicts are what a
+    flagship run would silently downgrade to cpu on), and exits 0: the
+    verdict IS the artifact, so a broken tunnel still produces one.
+    BENCH_PREFLIGHT_ATTEMPTS (default 2) and
+    MMLSPARK_TPU_BENCH_PROBE_TIMEOUT_S bound the wait."""
+    from mmlspark_tpu.core.retries import RetryPolicy, with_retries
+
+    def probe_once():
+        ok, detail = probe_backend()
+        if not ok:
+            raise RuntimeError(detail)
+        return detail
+
+    attempts = int(os.environ.get("BENCH_PREFLIGHT_ATTEMPTS", 2))
+    t0 = time.perf_counter()
+    try:
+        detail = with_retries(
+            probe_once,
+            policy=RetryPolicy(max_attempts=max(attempts, 1),
+                               base_delay=1.0, max_delay=10.0),
+            describe="bench.backend_preflight")
+        ok = True
+    except Exception as e:
+        ok, detail = False, str(e)
+    verdict = classify_probe(ok, detail)
+    PREFLIGHT.update(verdict=verdict, detail=detail)
+    print(json.dumps({
+        "metric": "backend_preflight", "value": verdict,
+        "unit": "verdict", "vs_baseline": None,
+        "probe_s": round(time.perf_counter() - t0, 2),
+        "attempts": max(attempts, 1),
+        "detail": detail,
+        "fallback": None if ok else "cpu",
+    }))
+
+
+def serving_elastic_main():
+    """``python bench.py --serving-elastic``: the elastic-fleet row —
+    sustained fleet load whose offered client count DOUBLES at half
+    time while the FleetSupervisor autoscales workers; one
+    ``serving_elastic`` JSON row with the worker-count trajectory,
+    shed counts, and p99 before/after the doubling
+    (tools/bench_serving.py emit_elastic). BENCH_SERVING_CLIENTS /
+    BENCH_SERVING_DURATION_S override the load shape for rehearsals."""
+    platform = wait_for_backend(metric="serving_elastic", unit="qps",
+                                allow_cpu_fallback=True)
+    print(f"# backend up: {platform}", file=sys.stderr, flush=True)
+    from mmlspark_tpu.core.compile_cache import enable_persistent_cache
+    enable_persistent_cache()
+    from tools.bench_serving import emit_elastic
+    emit_elastic(
+        clients=int(os.environ.get("BENCH_SERVING_CLIENTS", 16)),
+        duration_s=float(os.environ.get("BENCH_SERVING_DURATION_S", 12)),
+        extra={"backend_preflight": PREFLIGHT["verdict"]})
 
 
 def serving_sustained_main():
@@ -397,7 +485,11 @@ def serving_sustained_main():
 
 
 if __name__ == "__main__":
-    if "--serving-sustained" in sys.argv:
+    if "--preflight" in sys.argv:
+        preflight_main()
+    elif "--serving-elastic" in sys.argv:
+        serving_elastic_main()
+    elif "--serving-sustained" in sys.argv:
         serving_sustained_main()
     elif "--refresh-latency" in sys.argv:
         refresh_latency_main()

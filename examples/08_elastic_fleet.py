@@ -1,0 +1,156 @@
+"""Elastic serving fleet end to end: supervised autoscaling under
+load, per-tenant admission control, and the kill-mid-batch chaos
+drill that proves scale-down and failover lose nothing.
+
+A small GBDT serves behind a ``ServingFleet`` watched by a
+``FleetSupervisor``: offered load pushes the rolling service p99 past
+the scale threshold and the fleet grows toward its max; a hot tenant
+exhausts its token bucket and sheds with 503 + Retry-After while
+other tenants keep scoring; a worker killed mid-batch under the armed
+``serving.worker_kill`` fault has its in-flight request failed over by
+``FleetClient`` with a reply identical to a single-worker run, and the
+supervisor detects the death and respawns back to target size.
+Finally a graceful retirement drains every accepted request before the
+worker stops — zero loss.
+"""
+import _common
+
+_common.setup()
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.env import env_override
+from mmlspark_tpu.io.fleet import FleetSupervisor
+from mmlspark_tpu.io.serving import FleetClient, ServingFleet, ServingServer
+from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+
+N, F = 800, 6
+
+
+def _post(url, payload, timeout=10.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def main():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(N, F))
+    y = X @ rng.normal(size=F) + 0.1 * rng.normal(size=N)
+    model = LightGBMRegressor(numIterations=10, numLeaves=15, maxBin=31,
+                              seed=7).fit(
+        DataFrame({"features": X, "label": y}))
+    row = {"features": X[0].tolist()}
+
+    # -- 1. supervised autoscaling under load --------------------------------
+    fleet = ServingFleet(model, num_servers=1, max_latency_ms=5.0).start()
+    sup = FleetSupervisor(fleet, min_workers=1, max_workers=3,
+                          scale_p99_ms=1.0, heartbeat_s=0.2,
+                          cooldown_s=0.4, scale_streak=1).start()
+    client = FleetClient(fleet.registry_url, timeout=10.0)
+    print(f"fleet up: {len(fleet.worker_urls)} worker, envelope 1..3")
+    stop_load = threading.Event()
+
+    def hammer():
+        mine = FleetClient(fleet.registry_url, timeout=10.0)
+        while not stop_load.is_set():
+            try:
+                mine.score(dict(row))
+            except Exception:
+                time.sleep(0.01)  # shed under backpressure: retry
+
+    loaders = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(8)]
+    for t in loaders:
+        t.start()
+    deadline = time.monotonic() + 30.0
+    while len(fleet.worker_urls) < 3 and time.monotonic() < deadline:
+        time.sleep(0.1)
+    stop_load.set()
+    for t in loaders:
+        t.join(timeout=5)
+    stats = sup.stats()
+    print(f"load pushed p99 past {sup.scale_p99_ms} ms -> "
+          f"{stats['workers']} workers ({stats['scale_ups']} scale-ups)")
+    assert stats["workers"] == 3
+    sup.stop()  # manual ticks from here: the drills stay deterministic
+
+    # -- 2. kill-mid-batch chaos drill ---------------------------------------
+    reference = client.score(dict(row))
+    faults.arm("serving.worker_kill", "raise", count=1)
+    survived = client.score(dict(row))  # worker dies; client fails over
+    faults.disarm("serving.worker_kill")
+    assert survived == reference
+    print(f"worker killed mid-batch: failover reply identical "
+          f"({survived['prediction']:.6f})")
+    for _ in range(sup.dead_after_misses):
+        sup.tick()  # heartbeat sweeps: detect the corpse, respawn
+    stats = sup.stats()
+    print(f"supervisor: {stats['deaths']} death detected, fleet back "
+          f"to {stats['workers']} workers")
+    assert stats["deaths"] == 1 and stats["workers"] == 3
+
+    # -- 3. graceful retirement: drain loses zero accepted requests ---------
+    victim = fleet.servers[0]
+    pending = []
+    threads = [threading.Thread(
+        target=lambda: pending.append(_post(victim.url, dict(row))),
+        daemon=True) for _ in range(4)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:  # all 4 accepted (or answered)
+        with victim._lock:
+            depth = sum(len(m.queue) for m in victim._models.values())
+        if depth + victim._inflight_batches + len(pending) >= 4:
+            break
+        time.sleep(0.005)
+    fleet.remove_worker(victim)  # clients stop discovering it ...
+    assert victim.drain(timeout_s=10.0)  # ... accepted work flushes ...
+    victim.stop()  # ... THEN it stops
+    for t in threads:
+        t.join(timeout=10)
+    assert len(pending) == 4 and all(
+        p["prediction"] == reference["prediction"] for p in pending)
+    print("graceful retirement: 4 in-flight requests all answered, "
+          "then the worker stopped")
+    fleet.stop()
+
+    # -- 4. per-tenant admission control -------------------------------------
+    with env_override("MMLSPARK_TPU_SERVE_TENANT_RATE", "0.5"), \
+            env_override("MMLSPARK_TPU_SERVE_TENANT_BURST", "2"):
+        with ServingServer(model, max_latency_ms=2.0) as server:
+            ok = shed = 0
+            for _ in range(6):
+                try:
+                    _post(server.url, {**row, "__tenant__": "hot"})
+                    ok += 1
+                except urllib.error.HTTPError as e:
+                    assert e.code == 503 and e.headers["Retry-After"]
+                    shed += 1
+            _post(server.url, {**row, "__tenant__": "cool"})
+            with urllib.request.urlopen(
+                    f"http://{server.host}:{server.port}"
+                    "/models/default/healthz", timeout=5) as r:
+                h = json.loads(r.read())
+            print(f"tenant 'hot': {ok} admitted, {shed} shed "
+                  f"(503 + Retry-After); tenant 'cool' untouched "
+                  f"(counters: {h['tenants']['hot']})")
+            assert ok == 2 and shed == 4
+            assert h["tenants"]["cool"]["shed_tenant"] == 0
+
+    print("OK 08_elastic_fleet")
+
+
+if __name__ == "__main__":
+    main()

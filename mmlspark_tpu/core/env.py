@@ -198,6 +198,38 @@ DRIFT_THRESHOLD = register(
     "drift-detector arm level for the max per-feature statistic "
     "(PSI default 0.2, the standard significant-shift level; for the "
     "ks metric pick ~0.1-0.15) — exploratory/drift.py")
+FLEET_MIN = register(
+    "MMLSPARK_TPU_FLEET_MIN", "int", 1,
+    "elastic serving fleet: minimum worker count the FleetSupervisor "
+    "retires down to (io/fleet.py)")
+FLEET_MAX = register(
+    "MMLSPARK_TPU_FLEET_MAX", "int", 4,
+    "elastic serving fleet: maximum worker count the FleetSupervisor "
+    "scales up to")
+FLEET_SCALE_P99_MS = register(
+    "MMLSPARK_TPU_FLEET_SCALE_P99_MS", "float", 250.0,
+    "elastic serving fleet: worker p99 latency (ms) above which the "
+    "supervisor arms a scale-up; scale-down arms below a quarter of it "
+    "(hysteresis)")
+FLEET_COOLDOWN_S = register(
+    "MMLSPARK_TPU_FLEET_COOLDOWN_S", "float", 10.0,
+    "elastic serving fleet: seconds after any scaling action before "
+    "the next one may fire (flap damping)")
+FLEET_HEARTBEAT_S = register(
+    "MMLSPARK_TPU_FLEET_HEARTBEAT_S", "float", 1.0,
+    "elastic serving fleet: seconds between supervisor /healthz "
+    "heartbeat sweeps; K consecutive missed heartbeats mark a worker "
+    "dead")
+SERVE_TENANT_RATE = register(
+    "MMLSPARK_TPU_SERVE_TENANT_RATE", "float", 0.0,
+    "serving admission control: per-tenant token-bucket refill rate in "
+    "requests/s (tenant from the __tenant__ payload field or X-Tenant "
+    "header; 0 = admission token buckets off)")
+SERVE_TENANT_BURST = register(
+    "MMLSPARK_TPU_SERVE_TENANT_BURST", "int", 8,
+    "serving admission control: per-tenant token-bucket capacity "
+    "(burst size); an over-budget tenant sheds with 503 + Retry-After "
+    "without dragging other tenants' p99")
 BENCH_PROBE_TIMEOUT_S = register(
     "MMLSPARK_TPU_BENCH_PROBE_TIMEOUT_S", "int", 90,
     "bench.py: seconds per TPU backend probe attempt")

@@ -77,6 +77,19 @@ KNOWN_POINTS: Dict[str, str] = {
                      "(ServingServer.swap_model) — a corrupted or "
                      "crashed swap that must roll back to the old "
                      "model",
+    "fleet.spawn": "ServingFleet worker construction "
+                   "(ServingFleet._make_server) — a worker that fails "
+                   "to come up; the supervisor's restart path must "
+                   "retry with backoff",
+    "fleet.heartbeat": "FleetSupervisor /healthz probe "
+                       "(io/fleet.py) — a lost or timed-out "
+                       "heartbeat; K consecutive misses mark the "
+                       "worker dead and evict it",
+    "serving.worker_kill": "ServingServer batch loop, once per drained "
+                           "batch — armed, the worker dies abruptly "
+                           "mid-batch (no flush, connections reset) to "
+                           "prove fleet failover and supervised "
+                           "restart",
 }
 
 _VALID_ACTIONS = ("raise", "delay", "corrupt")

@@ -7,6 +7,7 @@ continuous/HTTPSourceV2.scala:80) plus the cognitive-services client
 layer (services/CognitiveServiceBase.scala:491, openai/*).
 """
 
+from mmlspark_tpu.io.fleet import FleetSupervisor
 from mmlspark_tpu.io.http import (
     HTTPResponseData,
     HTTPTransformer,
@@ -66,7 +67,7 @@ from mmlspark_tpu.io.binary import (
 
 __all__ = ["HTTPTransformer", "SimpleHTTPTransformer", "HTTPResponseData",
            "ServingServer", "ServingFleet", "ContinuousServingServer",
-           "FleetClient", "SwapFailed",
+           "FleetClient", "FleetSupervisor", "SwapFailed",
            "RefreshController", "RefreshResult", "StreamBuffer",
            "serve_pipeline", "serve_distributed", "serve_continuous",
            "CognitiveServiceTransformer", "OpenAIChatCompletion",

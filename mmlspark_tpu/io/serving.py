@@ -46,17 +46,42 @@ with per-model bounded queues, routed by path
 per-model stats. Compiled scorers stay resident for the
 ``MMLSPARK_TPU_SERVE_WARM_MODELS`` most-recently-scored models (LRU);
 evicted-cold models drop their plane + jit cache and rebuild lazily.
+
+Admission control (the QoS side of the bounded-queue backpressure):
+requests carry a tenant (``__tenant__`` payload field or ``X-Tenant``
+header) and a priority (``__priority__`` / ``X-Priority``, ``low`` or
+``high``). With ``MMLSPARK_TPU_SERVE_TENANT_RATE`` > 0 each tenant
+draws from a token bucket (burst ``MMLSPARK_TPU_SERVE_TENANT_BURST``)
+and an over-budget tenant sheds with ``503 + Retry-After`` before it
+can queue — a hot tenant degrades alone instead of dragging p99 for
+everyone. Independent of budgets, once a model's queue crosses its
+high-water mark (``queue_high_water``, default ``max_queue // 2``)
+low-priority requests shed while high-priority traffic keeps
+queueing up to the hard bound. ``admitted`` / ``shed_tenant`` /
+``shed_priority`` counters surface in ``/healthz`` per model and per
+tenant, alongside rolling ``p50_ms`` / ``p99_ms`` service latency —
+the signals the :class:`~mmlspark_tpu.io.fleet.FleetSupervisor`
+autoscaler polls.
+
+Fleet elasticity: :class:`ServingFleet` grows and shrinks at runtime
+(``spawn_worker`` / ``remove_worker``; registry reads are
+snapshot-consistent), workers die abruptly for chaos drills
+(:meth:`ServingServer.kill` — no flush, connections reset; armed via
+the ``serving.worker_kill`` fault point) and retire gracefully
+(:meth:`ServingServer.drain` — stop admitting, flush pendings, then
+deregister), so scale-down loses zero accepted requests.
 """
 
 from __future__ import annotations
 
 import json
+import socket
 import threading
 import time
 import urllib.parse
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -66,7 +91,10 @@ from mmlspark_tpu.core.env import (
     SERVE_BINNED,
     SERVE_BUCKETS,
     SERVE_MODEL_QUEUE,
+    SERVE_TENANT_BURST,
+    SERVE_TENANT_RATE,
     SERVE_WARM_MODELS,
+    env_float,
     env_int,
     env_str,
 )
@@ -94,6 +122,11 @@ class _CappedThreadingHTTPServer(ThreadingHTTPServer):
         self._conn_sem = threading.BoundedSemaphore(max_connections)
         self._retry_after_s = retry_after_s
         self.rejected_connections = 0
+        # live per-connection sockets, so an abrupt kill() can reset
+        # every in-flight client (the chaos contract: a dead worker
+        # looks DEAD — connection errors, not polite 5xx replies)
+        self._active_lock = threading.Lock()
+        self._active: set = set()
 
     def process_request(self, request, client_address):
         if not self._conn_sem.acquire(blocking=False):
@@ -112,10 +145,14 @@ class _CappedThreadingHTTPServer(ThreadingHTTPServer):
                 pass
             self.shutdown_request(request)
             return
+        with self._active_lock:
+            self._active.add(request)
         try:
             super().process_request(request, client_address)
         except BaseException:
             self._conn_sem.release()
+            with self._active_lock:
+                self._active.discard(request)
             raise
 
     def process_request_thread(self, request, client_address):
@@ -123,10 +160,36 @@ class _CappedThreadingHTTPServer(ThreadingHTTPServer):
             super().process_request_thread(request, client_address)
         finally:
             self._conn_sem.release()
+            with self._active_lock:
+                self._active.discard(request)
+
+    def kill_connections(self) -> None:
+        """Hard-reset every live connection (no goodbye): clients see
+        a connection error mid-request, exactly as if the worker
+        process died. Handler threads unblock on their next socket op
+        and exit through :meth:`handle_error`."""
+        with self._active_lock:
+            conns = list(self._active)
+            self._active.clear()
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def handle_error(self, request, client_address):
+        # client disconnects and killed connections are normal under
+        # load / chaos; the default traceback dump would spam stderr
+        logger.debug("serving connection error from %s", client_address,
+                     exc_info=True)
 
 
 class _Pending:
-    __slots__ = ("payload", "event", "reply", "error", "binned")
+    __slots__ = ("payload", "event", "reply", "error", "binned", "t0")
 
     def __init__(self, payload):
         self.payload = payload
@@ -134,6 +197,46 @@ class _Pending:
         self.reply = None
         self.error = None
         self.binned = None  # pre-binned (F,) row, set on request threads
+        self.t0 = time.monotonic()  # admission time, for service p99
+
+
+class _TokenBucket:
+    """Per-tenant admission budget: ``rate`` tokens/s refill up to
+    ``burst``; a request costs one token, an empty bucket sheds. Lazy
+    refill on each take — no timer thread per tenant. Callers hold the
+    server lock, so no lock of its own."""
+
+    __slots__ = ("rate", "burst", "tokens", "t_last")
+
+    def __init__(self, rate: float, burst: int):
+        self.rate = rate
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.t_last = time.monotonic()
+
+    def take(self) -> bool:
+        now = time.monotonic()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.t_last) * self.rate)
+        self.t_last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+def _latency_pctls(entries, now: float,
+                   window_s: float) -> Tuple[Optional[float],
+                                             Optional[float]]:
+    """(p50_ms, p99_ms) over ``(t_done, lat_ms)`` entries completed in
+    the trailing ``window_s`` — a rolling window, not all-time, so an
+    idle worker's percentiles decay and the autoscaler can see calm."""
+    lat = sorted(ms for t, ms in entries if now - t <= window_s)
+    if not lat:
+        return None, None
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+    return round(p50, 3), round(p99, 3)
 
 
 def _bucket_ladder(max_batch_size: int) -> List[int]:
@@ -238,7 +341,15 @@ class _ServedModel:
                       "timeouts": 0, "binned_batches": 0,
                       "generic_batches": 0, "binned_fallbacks": 0,
                       "cold_rebuilds": 0, "evictions": 0,
-                      "swaps": 0, "swap_rollbacks": 0}
+                      "swaps": 0, "swap_rollbacks": 0,
+                      "admitted": 0, "shed_tenant": 0,
+                      "shed_priority": 0}
+        # rolling (t_done, lat_ms) service latencies (admission ->
+        # reply) feeding the /healthz p50/p99 the autoscaler reads
+        self.latencies: deque = deque(maxlen=1024)
+        # per-tenant admission counters (bounded: past _MAX_TENANTS
+        # distinct tenants, new ones aggregate under "__other__")
+        self.tenants: Dict[str, Dict[str, int]] = {}
         self.plane: Optional[_BinnedPlane] = None
         self.binned_mode = "off"            # resolved at start()
         self.binned_supported: Optional[bool] = None  # None = untried
@@ -268,7 +379,8 @@ class ServingServer:
                  retry_after_s: float = 1.0,
                  models: Optional[Dict[str, Transformer]] = None,
                  default_model: Optional[str] = None,
-                 warmup_payload: Optional[dict] = None):
+                 warmup_payload: Optional[dict] = None,
+                 queue_high_water: Optional[int] = None):
         if (model is None) == (models is None):
             raise ValueError("pass exactly one of model= or models=")
         if models is None:
@@ -293,6 +405,22 @@ class ServingServer:
         self.request_timeout_s = request_timeout_s
         self.retry_after_s = retry_after_s
         self._warmup_payload = warmup_payload
+        # admission control: the priority high-water mark (low-priority
+        # requests shed once a model's queue crosses it; high-priority
+        # traffic keeps queueing to the hard max_queue bound) and the
+        # per-tenant token buckets (rate 0 = budgets off)
+        self.queue_high_water = (queue_high_water if queue_high_water
+                                 is not None else max(max_queue // 2, 1))
+        self._tenant_rate = env_float(SERVE_TENANT_RATE, 0.0, minimum=0.0)
+        self._tenant_burst = env_int(SERVE_TENANT_BURST, 8, minimum=1)
+        self._tenant_buckets: Dict[str, _TokenBucket] = {}
+        # lifecycle flags: draining = stop admitting, flush pendings
+        # (graceful retirement); killed = abrupt chaos death
+        self._draining = False
+        self._killed = False
+        self._started = False
+        self._stopped = False
+        self._inflight_batches = 0
         per_model_queue = env_int(SERVE_MODEL_QUEUE, 0, minimum=0)
         self._models: Dict[str, _ServedModel] = {
             name: _ServedModel(name, m, per_model_queue or max_queue,
@@ -306,7 +434,9 @@ class ServingServer:
         self._lock = threading.Condition()
         self._stop = False
         self._stats = {"served": 0, "errors": 0, "rejected": 0,
-                       "timeouts": 0, "swaps": 0, "swap_rollbacks": 0}
+                       "timeouts": 0, "swaps": 0, "swap_rollbacks": 0,
+                       "admitted": 0, "shed_tenant": 0,
+                       "shed_priority": 0}
         self._last_shed = 0.0  # monotonic time of the last 503
         self._last_binned_fallback = 0.0
         # model-name -> degradation reason while a hot-swap is running
@@ -360,6 +490,15 @@ class ServingServer:
                 self.send_error(404)
 
             def do_POST(self):
+                if server._draining:
+                    # graceful retirement: stop accepting, flush what
+                    # was already admitted — a retiring worker turns
+                    # new traffic away so scale-down loses nothing
+                    self._reply_json(
+                        503, {"error": "worker draining"},
+                        {"Retry-After":
+                         str(max(int(server.retry_after_s), 1))})
+                    return
                 served = server._route_post(self.path)
                 if served is None:
                     self.send_error(404)
@@ -383,6 +522,23 @@ class ServingServer:
                     if served is None:
                         self.send_error(404, f"unknown model {route!r}")
                         return
+                # admission control: tenant + priority ride in the
+                # payload (stripped before scoring) or headers
+                tenant = priority = None
+                if isinstance(payload, dict):
+                    tenant = payload.pop("__tenant__", None)
+                    priority = payload.pop("__priority__", None)
+                tenant = str(tenant or self.headers.get("X-Tenant")
+                             or "default")
+                priority = str(priority or self.headers.get("X-Priority")
+                               or "high").strip().lower()
+                shed = server._admit(served, tenant, priority)
+                if shed is not None:
+                    self._reply_json(
+                        503, {"error": shed},
+                        {"Retry-After":
+                         str(max(int(server.retry_after_s), 1))})
+                    return
                 pending = _Pending(payload)
                 plane = served.plane
                 if plane is not None:
@@ -414,7 +570,17 @@ class ServingServer:
                     self.send_error(504, "scoring timed out")
                     return
                 if pending.error is not None:
-                    self.send_error(500, pending.error)
+                    if pending.error in ("server stopped",
+                                         "worker killed"):
+                        # lifecycle flush, not the request's fault:
+                        # 503 tells FleetClient to fail over to
+                        # another worker instead of raising
+                        self._reply_json(
+                            503, {"error": pending.error},
+                            {"Retry-After":
+                             str(max(int(server.retry_after_s), 1))})
+                    else:
+                        self.send_error(500, pending.error)
                     return
                 body = json.dumps(pending.reply).encode()
                 self.send_response(200)
@@ -427,10 +593,13 @@ class ServingServer:
             (host, port), Handler, max_connections=max_connections,
             retry_after_s=retry_after_s)
         self.host, self.port = self._httpd.server_address
+        # named threads so teardown tests can assert none leaked
         self._server_thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"mmlspark-serve-http-{self.port}")
         self._batch_thread = threading.Thread(
-            target=self._batch_loop, daemon=True)
+            target=self._batch_loop, daemon=True,
+            name=f"mmlspark-serve-batch-{self.port}")
 
     # -- routing -------------------------------------------------------------
     def _route_post(self, path: str) -> Optional[_ServedModel]:
@@ -466,12 +635,83 @@ class ServingServer:
             self._lock.notify()
             return True
 
+    # -- admission control ---------------------------------------------------
+    # bounded per-tenant state: beyond this many distinct tenants, new
+    # ones aggregate under "__other__" (counters AND token bucket) so a
+    # tenant-id-spraying client cannot grow server memory
+    _MAX_TENANTS = 256
+    # rolling window for the /healthz p50/p99 the autoscaler reads
+    _latency_window_s = 30.0
+
+    def _tenant_counters(self, served: _ServedModel,
+                         tenant: str) -> Dict[str, int]:
+        counters = served.tenants.get(tenant)
+        if counters is None:
+            if (tenant != "__other__"
+                    and len(served.tenants) >= self._MAX_TENANTS):
+                return self._tenant_counters(served, "__other__")
+            counters = {"admitted": 0, "shed_tenant": 0,
+                        "shed_priority": 0}
+            served.tenants[tenant] = counters
+        return counters
+
+    def _admit(self, served: _ServedModel, tenant: str,
+               priority: str) -> Optional[str]:
+        """Admission decision for one request: ``None`` admits, a
+        string is the shed reason for the 503 body. Two independent
+        gates — the per-tenant token bucket (active only with
+        ``MMLSPARK_TPU_SERVE_TENANT_RATE`` > 0, so a hot tenant sheds
+        alone) and priority shedding once the model queue crosses its
+        high-water mark (low-priority traffic sheds first; high
+        priority keeps queueing to the hard bound, keeping its p99)."""
+        with self._lock:
+            counters = self._tenant_counters(served, tenant)
+            if self._tenant_rate > 0.0:
+                bucket = self._tenant_buckets.get(tenant)
+                if bucket is None:
+                    if len(self._tenant_buckets) >= self._MAX_TENANTS:
+                        bucket = self._tenant_buckets.setdefault(
+                            "__other__",
+                            _TokenBucket(self._tenant_rate,
+                                         self._tenant_burst))
+                    else:
+                        bucket = self._tenant_buckets[tenant] = \
+                            _TokenBucket(self._tenant_rate,
+                                         self._tenant_burst)
+                if not bucket.take():
+                    counters["shed_tenant"] += 1
+                    served.stats["shed_tenant"] += 1
+                    self._stats["shed_tenant"] += 1
+                    self._last_shed = time.monotonic()
+                    return (f"tenant {tenant!r} over budget "
+                            f"(rate={self._tenant_rate:g}/s, "
+                            f"burst={self._tenant_burst})")
+            if (priority == "low"
+                    and len(served.queue) >= self.queue_high_water):
+                counters["shed_priority"] += 1
+                served.stats["shed_priority"] += 1
+                self._stats["shed_priority"] += 1
+                self._last_shed = time.monotonic()
+                return (f"queue past high-water mark "
+                        f"({self.queue_high_water}); low-priority "
+                        "request shed")
+            counters["admitted"] += 1
+            served.stats["admitted"] += 1
+            self._stats["admitted"] += 1
+            return None
+
     # -- health --------------------------------------------------------------
     def _model_health(self, served: _ServedModel) -> Dict[str, Any]:
         with self._lock:
+            p50, p99 = _latency_pctls(list(served.latencies),
+                                      time.monotonic(),
+                                      self._latency_window_s)
             health = {"name": served.name, "queueDepth": len(served.queue),
                       "maxQueue": served.max_queue,
                       "warm": served.name in self._warm,
+                      "p50_ms": p50, "p99_ms": p99,
+                      "tenants": {t: dict(c)
+                                  for t, c in served.tenants.items()},
                       "binned": {"mode": served.binned_mode,
                                  "active": served.plane is not None,
                                  "reason": served.binned_reason},
@@ -508,12 +748,19 @@ class ServingServer:
             last_shed = self._last_shed
             last_fallback = self._last_binned_fallback
             swapping = sorted(self._swapping)
+            draining = self._draining
+            entries: List[Tuple[float, float]] = []
+            for m in self._models.values():
+                entries.extend(m.latencies)
             default = self._models[self._default]
             binned = {"mode": default.binned_mode,
                       "active": default.plane is not None,
                       "reason": default.binned_reason}
         now = time.monotonic()
+        p50, p99 = _latency_pctls(entries, now, self._latency_window_s)
         reasons: List[str] = []
+        if draining:
+            reasons.append("draining")
         if swapping:
             reasons.append("swap-in-progress: " + ", ".join(swapping))
         if depth >= max(self.max_queue // 2, 1):
@@ -525,6 +772,7 @@ class ServingServer:
         health = {"status": "degraded" if reasons else "ok",
                   "reason": "; ".join(reasons) if reasons else None,
                   "queueDepth": depth, "maxQueue": self.max_queue,
+                  "p50_ms": p50, "p99_ms": p99, "draining": draining,
                   "rejectedConnections": getattr(
                       self._httpd, "rejected_connections", 0), **stats,
                   "binned": binned, "buckets": list(self._ladder)}
@@ -751,6 +999,7 @@ class ServingServer:
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ServingServer":
         self._warm_start()
+        self._started = True
         self._server_thread.start()
         self._batch_thread.start()
         logger.info("serving on %s:%s%s (%d model(s))", self.host,
@@ -758,6 +1007,10 @@ class ServingServer:
         return self
 
     def stop(self) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
         self._stop = True
         with self._lock:
             flush: List[_Pending] = []
@@ -770,8 +1023,58 @@ class ServingServer:
             # sustained-load contract is "no deadlock on stop"
             p.error = "server stopped"
             p.event.set()
-        self._httpd.shutdown()
+        if self._started:
+            # shutdown() waits on the serve_forever loop; on a worker
+            # that never started (e.g. a failed spawn) it would hang
+            self._httpd.shutdown()
         self._httpd.server_close()
+
+    def kill(self) -> None:
+        """Abrupt chaos death (the ``serving.worker_kill`` contract):
+        no flush, no goodbye. Pending requests error out, every live
+        connection is hard-reset so clients see a connection error —
+        the signal :class:`FleetClient` fails over on — and the HTTP
+        listener stops. The :class:`~mmlspark_tpu.io.fleet.\\
+FleetSupervisor` notices via missed heartbeats and respawns."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._killed = True
+            self._stop = True
+            flush: List[_Pending] = []
+            for m in self._models.values():
+                flush.extend(m.queue)
+                m.queue.clear()
+            self._lock.notify_all()
+        for p in flush:
+            p.error = "worker killed"
+            p.event.set()
+        self._httpd.kill_connections()
+        if self._started:
+            self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful retirement, phase 1: stop admitting (new POSTs get
+        ``503 + Retry-After``; deregister from the fleet first so
+        clients stop picking this worker), then wait until every
+        already-accepted request has been scored and replied — queues
+        empty AND no batch in flight. Returns True when fully drained,
+        False on timeout (pendings may remain). Call :meth:`stop`
+        afterwards; the drain guarantee is that scale-down loses zero
+        accepted requests."""
+        self._draining = True
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            while True:
+                depth = sum(len(m.queue) for m in self._models.values())
+                if depth == 0 and self._inflight_batches == 0:
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._lock.wait(timeout=min(remaining, 0.1))
 
     @property
     def url(self) -> str:
@@ -814,20 +1117,40 @@ class ServingServer:
                         deadline - time.monotonic(), 0.0))
                 batch = served.queue[:self.max_batch_size]
                 del served.queue[:len(batch)]
+                if batch:
+                    self._inflight_batches += 1
             if not batch:  # all requests timed out during the wait
                 continue
             try:
-                self._score(batch, served)
+                try:
+                    # chaos point: armed, the worker dies abruptly with
+                    # this batch in flight — the fleet failover drill
+                    fault_point("serving.worker_kill")
+                except Exception:
+                    self.kill()
+                    for p in batch:
+                        p.error = "worker killed"
+                        p.event.set()
+                    return
+                try:
+                    self._score(batch, served)
+                    with self._lock:
+                        self._stats["served"] += len(batch)
+                        served.stats["served"] += len(batch)
+                except Exception as e:  # surface scoring errors to callers
+                    with self._lock:
+                        self._stats["errors"] += len(batch)
+                        served.stats["errors"] += len(batch)
+                    for p in batch:
+                        p.error = str(e)
+                        p.event.set()
+            finally:
+                # drain() waits on queues empty AND in-flight zero: a
+                # popped batch is invisible to queue depth, so it needs
+                # its own counter
                 with self._lock:
-                    self._stats["served"] += len(batch)
-                    served.stats["served"] += len(batch)
-            except Exception as e:  # surface scoring errors to callers
-                with self._lock:
-                    self._stats["errors"] += len(batch)
-                    served.stats["errors"] += len(batch)
-                for p in batch:
-                    p.error = str(e)
-                    p.event.set()
+                    self._inflight_batches -= 1
+                    self._lock.notify_all()
 
     @staticmethod
     def _consumes_id_column(m) -> bool:
@@ -895,6 +1218,7 @@ class ServingServer:
         # score-path jit-boundary guard: a NaN prediction here would
         # otherwise serialize into a client-visible JSON "NaN"
         sanitizer.check_finite("serving.score", cols)
+        t_done = time.monotonic()
         for i, p in enumerate(batch):
             reply = {}
             for c, values in cols.items():
@@ -907,6 +1231,9 @@ class ServingServer:
             if ids[i] is not None:  # request-id correlation for clients
                 reply["id"] = ids[i]
             p.reply = reply
+            # admission -> reply service latency feeds the rolling
+            # /healthz percentiles (deque append is atomic; no lock)
+            served.latencies.append((t_done, (t_done - p.t0) * 1e3))
             p.event.set()
 
 
@@ -929,6 +1256,7 @@ class ContinuousServingServer(ServingServer):
 
     def start(self) -> "ContinuousServingServer":
         self._warm_start()
+        self._started = True
         self._server_thread.start()  # no batch thread: scoring is inline
         logger.info("continuous serving on %s:%s%s", self.host, self.port,
                     self.api_path)
@@ -977,9 +1305,15 @@ class ServingFleet:
                  num_servers: int = 2,
                  continuous: bool = False, host: str = "127.0.0.1",
                  **server_kwargs):
-        cls = ContinuousServingServer if continuous else ServingServer
-        self.servers = [cls(model, host=host, port=0, **server_kwargs)
-                        for _ in range(num_servers)]
+        # construction config is retained so the fleet can build
+        # replacement and scale-up workers at runtime (FleetSupervisor)
+        self._model = model
+        self._continuous = continuous
+        self._host = host
+        self._server_kwargs = dict(server_kwargs)
+        self._servers_lock = threading.Lock()
+        self._started = False
+        self.servers = [self._make_server() for _ in range(num_servers)]
         fleet = self
 
         class RegistryHandler(BaseHTTPRequestHandler):
@@ -987,13 +1321,18 @@ class ServingFleet:
                 pass
 
             def do_GET(self):
+                # snapshot under the membership lock: spawn/retire may
+                # run concurrently, and a registry read must never see
+                # a half-updated worker list
+                with fleet._servers_lock:
+                    servers = list(fleet.servers)
                 if self.path == "/registry":
-                    obj = {"workers": [s.url for s in fleet.servers]}
+                    obj = {"workers": [s.url for s in servers]}
                 elif self.path == "/healthz":
                     # fleet-level health: the registry runs in-process
                     # with its workers, so it can aggregate their
                     # health snapshots without extra HTTP hops
-                    workers = [s._health() for s in fleet.servers]
+                    workers = [s._health() for s in servers]
                     status = ("degraded" if any(
                         w["status"] != "ok" for w in workers) else "ok")
                     obj = {"status": status, "workers": workers}
@@ -1010,7 +1349,38 @@ class ServingFleet:
         self._registry = ThreadingHTTPServer((host, 0), RegistryHandler)
         self.registry_host, self.registry_port = self._registry.server_address
         self._registry_thread = threading.Thread(
-            target=self._registry.serve_forever, daemon=True)
+            target=self._registry.serve_forever, daemon=True,
+            name="mmlspark-fleet-registry")
+
+    def _make_server(self) -> ServingServer:
+        """Construct one worker (not started). ``fleet.spawn`` makes
+        bring-up failable for chaos tests — the supervisor's restart
+        path must retry it with backoff, not crash."""
+        fault_point("fleet.spawn")
+        cls = ContinuousServingServer if self._continuous else ServingServer
+        return cls(self._model, host=self._host, port=0,
+                   **self._server_kwargs)
+
+    def spawn_worker(self) -> ServingServer:
+        """Grow the fleet by one worker (started when the fleet is
+        running); it appears in ``/registry`` as soon as it can score."""
+        server = self._make_server()
+        if self._started:
+            server.start()
+        with self._servers_lock:
+            self.servers.append(server)
+        return server
+
+    def remove_worker(self, server: ServingServer) -> bool:
+        """Deregister a worker (does NOT stop it — retirement drains
+        or kills it separately, AFTER it stops being discoverable).
+        Returns False when it was already gone."""
+        with self._servers_lock:
+            try:
+                self.servers.remove(server)
+                return True
+            except ValueError:
+                return False
 
     @property
     def registry_url(self) -> str:
@@ -1018,21 +1388,41 @@ class ServingFleet:
 
     @property
     def worker_urls(self) -> List[str]:
-        return [s.url for s in self.servers]
+        with self._servers_lock:
+            return [s.url for s in self.servers]
 
     def start(self) -> "ServingFleet":
-        for s in self.servers:
+        with self._servers_lock:
+            servers = list(self.servers)
+        for s in servers:
             s.start()
+        self._started = True
         self._registry_thread.start()
         logger.info("serving fleet: %d workers, registry %s",
-                    len(self.servers), self.registry_url)
+                    len(servers), self.registry_url)
         return self
 
     def stop(self) -> None:
-        for s in self.servers:
-            s.stop()
-        self._registry.shutdown()
-        self._registry.server_close()
+        """Tear the whole fleet down. One worker's failing ``stop()``
+        must not leak the others or the registry handler thread: every
+        worker gets its own try, the registry shuts down in a finally,
+        and the FIRST worker error re-raises after the full sweep."""
+        with self._servers_lock:
+            servers = list(self.servers)
+        first: Optional[BaseException] = None
+        try:
+            for s in servers:
+                try:
+                    s.stop()
+                except BaseException as e:
+                    if first is None:
+                        first = e
+        finally:
+            if self._started:
+                self._registry.shutdown()
+            self._registry.server_close()
+        if first is not None:
+            raise first
 
     def __enter__(self):
         return self.start()
@@ -1137,22 +1527,30 @@ class FleetClient:
         if due:
             self.worker_health()
 
-    def _pick(self) -> Optional[str]:
+    def _pick(self, excluded: Optional[set] = None) -> Optional[str]:
+        """Next worker in rotation, skipping ``excluded`` (workers that
+        already dropped THIS request's connection — retrying them would
+        repeat the same failure) and, while alternatives remain,
+        degraded ones. All candidates degraded: degraded service beats
+        none. All candidates excluded: ``None`` — the caller
+        re-discovers."""
+        excluded = excluded or set()
         with self._lock:
             if not self._workers:
                 return None
             now = time.monotonic()
+            degraded_fallback: Optional[str] = None
             for _ in range(len(self._workers)):
                 url = self._workers[self._next % len(self._workers)]
                 self._next += 1
+                if url in excluded:
+                    continue
                 marked = self._degraded.get(url)
                 if marked is None or now - marked > self._degraded_ttl_s:
                     return url
-            # every worker is degraded: degraded service beats none —
-            # fall back to plain round-robin
-            url = self._workers[self._next % len(self._workers)]
-            self._next += 1
-            return url
+                if degraded_fallback is None:
+                    degraded_fallback = url
+            return degraded_fallback
 
     def _maybe_refresh(self) -> None:
         """Re-discover workers when the local list has shrunk below the
@@ -1171,49 +1569,68 @@ class FleetClient:
             except Exception:
                 pass
 
-    def score(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+    def _post(self, url: str, data: bytes) -> Dict[str, Any]:
         import urllib.request
+        req = urllib.request.Request(
+            url, data=data,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read())
+
+    def score(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Score ``payload`` on some worker, failing over by error
+        class: a connection-level failure (reset, refused, timeout)
+        means the worker is dead — evict it, exclude it from this
+        request's retries, and hedge on a DIFFERENT worker (scoring is
+        idempotent, so the retry is safe and the reply identical); a
+        503/504 means alive-but-shedding — mark degraded and rotate on
+        without evicting; any other HTTP status is a semantic error no
+        retry can fix and surfaces immediately."""
+        import urllib.error
         if not self._workers:
             self.refresh()
         else:
             self._maybe_refresh()
         if self.route_around_degraded:
             self._maybe_poll_health()
+        data = json.dumps(payload).encode()
         n = max(len(self._workers), 1)
         attempts = max(n * self.retries_per_worker, 1)
+        failed: set = set()  # connection-failed workers, this request
         last: Optional[Exception] = None
-        for i in range(attempts):
-            url = self._pick()
+        for _ in range(attempts):
+            url = self._pick(excluded=failed)
             if url is None:
-                raise RuntimeError(
-                    f"registry {self.registry_url} lists no workers")
+                break
             try:
-                req = urllib.request.Request(
-                    url, data=json.dumps(payload).encode(),
-                    headers={"Content-Type": "application/json"})
-                with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                    return json.loads(r.read())
+                return self._post(url, data)
+            except urllib.error.HTTPError as e:
+                if e.code in (503, 504):
+                    last = e
+                    with self._lock:
+                        self._degraded[url] = time.monotonic()
+                    continue
+                raise
             except Exception as e:  # dead worker: evict + fail over
                 last = e
+                failed.add(url)
                 with self._lock:
                     if url in self._workers:
                         self._workers.remove(url)
-                if i == attempts - 1:
-                    # last chance: addresses may be stale (fleet
-                    # restarted on fresh ports) — re-discover once
-                    try:
-                        self.refresh()
-                        url = self._pick()
-                        if url is not None:
-                            req = urllib.request.Request(
-                                url, data=json.dumps(payload).encode(),
-                                headers={"Content-Type":
-                                         "application/json"})
-                            with urllib.request.urlopen(
-                                    req, timeout=self.timeout) as r:
-                                return json.loads(r.read())
-                    except Exception as e2:
-                        last = e2
+        # last chance: addresses may be stale (fleet respawned workers
+        # on fresh ports) — re-discover once and try a fresh worker
+        try:
+            self.refresh()
+            url = self._pick(excluded=failed)
+            if url is not None:
+                return self._post(url, data)
+        except urllib.error.HTTPError:
+            raise
+        except Exception as e2:
+            last = e2
+        if last is None:
+            raise RuntimeError(
+                f"registry {self.registry_url} lists no workers")
         raise RuntimeError(
             f"all workers failed after {attempts} attempts: {last}")
 

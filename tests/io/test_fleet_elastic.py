@@ -1,0 +1,425 @@
+"""Elastic serving fleet: supervised autoscaling (scale-up under load,
+hysteresis, graceful-drain scale-down), the kill-mid-batch chaos drill
+(supervisor detection within the heartbeat budget, FleetClient failover
+with replies bitwise-identical to a single-worker run, fleet back to
+target size), per-tenant token-bucket admission with attributed
+counters, supervised restart of crashed workers, and leak-free
+ServingFleet teardown."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request as urllib_request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.core.env import env_override
+from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.io.fleet import FleetSupervisor
+from mmlspark_tpu.io.serving import FleetClient, ServingFleet, ServingServer
+
+pytestmark = pytest.mark.fleet_smoke
+
+
+class _ScaleModel(Transformer):
+    def __init__(self, factor):
+        super().__init__()
+        self.factor = factor
+
+    def _transform(self, df):
+        return df.with_column(
+            "scaled", np.asarray(df.col("x"), np.float64) * self.factor)
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _post(url, payload, headers=None, timeout=10.0):
+    req = urllib_request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib_request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(url, timeout=5.0):
+    with urllib_request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _named_serving_threads():
+    return {t for t in threading.enumerate()
+            if t.name.startswith(("mmlspark-serve", "mmlspark-fleet"))}
+
+
+def _wait_threads_gone(before, timeout=8.0):
+    """Threads born since ``before`` with serving/fleet names must
+    exit; returns the stragglers (empty = clean)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaked = _named_serving_threads() - before
+        leaked = {t for t in leaked if t.is_alive()}
+        if not leaked:
+            return set()
+        time.sleep(0.05)
+    return leaked
+
+
+# -- autoscaling -------------------------------------------------------------
+
+def test_scale_up_under_load():
+    """Offered load pushing the rolling p99 past the threshold must
+    grow the fleet toward max, one worker per (streak-satisfied,
+    cooled-down) supervision pass — and never past max."""
+    fleet = ServingFleet(_ScaleModel(2.0), num_servers=1,
+                         max_latency_ms=5.0).start()
+    sup = FleetSupervisor(fleet, min_workers=1, max_workers=3,
+                          scale_p99_ms=2.0, heartbeat_s=0.1,
+                          cooldown_s=0.0, scale_streak=1)
+    try:
+        url = fleet.worker_urls[0]
+        for i in range(6):  # batching waits ~5 ms -> p99 >> 2 ms
+            assert _post(url, {"x": float(i)})["scaled"] == 2.0 * i
+        sup.tick()
+        assert len(fleet.worker_urls) == 2
+        sup.tick()
+        assert len(fleet.worker_urls) == 3
+        sup.tick()  # at max: must NOT grow further
+        assert len(fleet.worker_urls) == 3
+        assert sup.stats()["scale_ups"] == 2
+        assert sup.target == 3
+    finally:
+        sup.stop()
+        fleet.stop()
+
+
+def test_scale_down_drains_gracefully():
+    """A calm fleet shrinks to min via graceful retirement: the
+    retired worker drains (counted) and its threads exit; the floor
+    holds."""
+    before = _named_serving_threads()
+    fleet = ServingFleet(_ScaleModel(2.0), num_servers=2,
+                         max_latency_ms=1.0).start()
+    sup = FleetSupervisor(fleet, min_workers=1, max_workers=2,
+                          heartbeat_s=0.1, cooldown_s=0.0,
+                          scale_streak=1, drain_timeout_s=5.0)
+    try:
+        sup.tick()  # no traffic: p99 None + empty queues = calm
+        assert len(fleet.worker_urls) == 1
+        assert sup.stats()["scale_downs"] == 1
+        assert sup.stats()["drained"] == 1
+        sup.tick()  # at min: must NOT shrink further
+        assert len(fleet.worker_urls) == 1
+        # the survivor still serves
+        assert _post(fleet.worker_urls[0], {"x": 4.0})["scaled"] == 8.0
+    finally:
+        sup.stop()
+        fleet.stop()
+    assert _wait_threads_gone(before) == set()
+
+
+def test_hysteresis_no_flap():
+    """Alternating hot/calm polls must never scale (streak resets),
+    the dead band between scale-up and scale-down thresholds counts
+    toward neither, and cooldown blocks an immediate reversal."""
+    fleet = ServingFleet(_ScaleModel(2.0), num_servers=1,
+                         max_latency_ms=1.0)
+    sup = FleetSupervisor(fleet, min_workers=1, max_workers=4,
+                          scale_p99_ms=100.0, cooldown_s=120.0,
+                          scale_streak=2)
+    hot = {"p99_ms": 500.0, "queueDepth": 0, "maxQueue": 256}
+    calm = {"p99_ms": 0.5, "queueDepth": 0, "maxQueue": 256}
+    mid = {"p99_ms": 50.0, "queueDepth": 0, "maxQueue": 256}  # dead band
+    for h in (hot, calm, hot, calm, hot, mid, hot):
+        sup._decide([h])
+        assert sup.target == 1  # no streak ever completes: no flap
+    # two consecutive hots complete the streak -> one scale-up ...
+    sup._decide([hot])
+    sup._decide([hot])
+    assert sup.target == 2
+    assert sup.stats()["scale_ups"] == 1
+    # ... and cooldown then blocks BOTH directions, however calm/hot
+    for h in (calm, calm, calm, hot, hot, hot):
+        sup._decide([h])
+    assert sup.target == 2
+
+
+# -- graceful retirement -----------------------------------------------------
+
+def test_drain_loses_zero_accepted_requests():
+    """The retirement contract: deregister -> drain -> stop loses no
+    accepted request — every request in the queue at drain time gets
+    its real reply, and new requests are turned away with 503 +
+    Retry-After."""
+    fleet = ServingFleet(_ScaleModel(3.0), num_servers=2,
+                         max_latency_ms=300.0, max_batch_size=64).start()
+    try:
+        victim = fleet.servers[0]
+        results = [None] * 8
+
+        def call(i):
+            try:
+                results[i] = _post(victim.url, {"x": float(i)})
+            except Exception as e:  # pragma: no cover - failure detail
+                results[i] = e
+
+        threads = [threading.Thread(target=call, args=(i,), daemon=True)
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        # wait until all 8 are ACCEPTED (queued), still unscored
+        # because the batcher waits max_latency_ms=300
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with victim._lock:
+                depth = sum(len(m.queue)
+                            for m in victim._models.values())
+            if depth + victim._inflight_batches >= 8:
+                break
+            time.sleep(0.005)
+        assert fleet.remove_worker(victim)
+        assert victim.url not in fleet.worker_urls
+        assert victim.drain(timeout_s=10.0)
+        # a drained worker sheds NEW traffic with a retry hint
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(victim.url, {"x": 99.0})
+        assert err.value.code == 503
+        assert int(err.value.headers["Retry-After"]) >= 1
+        victim.stop()
+        for t in threads:
+            t.join(timeout=10)
+        # zero loss: every accepted request got its true reply
+        for i, out in enumerate(results):
+            assert isinstance(out, dict) and out["scaled"] == 3.0 * i, \
+                f"request {i} lost in scale-down: {out!r}"
+    finally:
+        fleet.stop()
+
+
+# -- chaos drill: kill mid-batch ---------------------------------------------
+
+def test_kill_mid_batch_failover_and_respawn():
+    """The PR's chaos contract end-to-end: a worker dies abruptly
+    mid-batch under armed ``serving.worker_kill``; the in-flight
+    request fails over through FleetClient's connection-error retry
+    and every reply stays bitwise-identical to a single-worker run;
+    the supervisor detects the death within the heartbeat budget
+    (dead_after_misses passes) and returns the fleet to target size."""
+    model = _ScaleModel(1.5)
+    payloads = [{"x": float(i) + 0.25} for i in range(8)]
+    # reference: the same requests through one untouched worker
+    with ServingServer(model, max_latency_ms=1.0) as single:
+        reference = [_post(single.url, dict(p)) for p in payloads]
+
+    fleet = ServingFleet(model, num_servers=2, max_latency_ms=1.0).start()
+    sup = FleetSupervisor(fleet, min_workers=2, max_workers=2,
+                          heartbeat_s=0.1, cooldown_s=60.0,
+                          dead_after_misses=2)
+    client = FleetClient(fleet.registry_url, timeout=5.0)
+    try:
+        client.refresh()
+        faults.arm("serving.worker_kill", "raise", count=1)
+        replies = [client.score(dict(p)) for p in payloads]
+        faults.disarm("serving.worker_kill")
+        # bitwise contract: failover replies identical to single-worker
+        assert replies == reference
+        # exactly one worker died abruptly (still registered: the
+        # sweep, not the kill, owns eviction)
+        dead = [s for s in fleet.servers if s._killed]
+        assert len(dead) == 1
+        # supervisor: detection within the heartbeat budget =
+        # dead_after_misses consecutive sweeps, then respawn to target
+        for _ in range(sup.dead_after_misses):
+            sup.tick()
+        stats = sup.stats()
+        assert stats["deaths"] == 1
+        assert stats["workers"] == 2  # back to target size
+        assert dead[0].url not in fleet.worker_urls
+        assert len(set(fleet.worker_urls)) == 2
+        # the whole (post-respawn) fleet serves correctly
+        client.refresh()
+        for p, ref in zip(payloads, reference):
+            assert client.score(dict(p)) == ref
+    finally:
+        sup.stop()
+        fleet.stop()
+
+
+def test_supervisor_restarts_crashed_worker_with_spawn_backoff():
+    """A worker crashing outside any batch (hard kill) is detected via
+    missed heartbeats and replaced; a transient ``fleet.spawn``
+    failure during the replacement is absorbed by the supervisor's
+    retry/backoff instead of crashing it."""
+    fleet = ServingFleet(_ScaleModel(2.0), num_servers=2,
+                         max_latency_ms=1.0).start()
+    sup = FleetSupervisor(fleet, min_workers=2, max_workers=2,
+                          heartbeat_s=0.1, dead_after_misses=2)
+    try:
+        dead_url = fleet.servers[1].url
+        fleet.servers[1].kill()
+        # the respawn's first construction attempt fails (chaos), the
+        # with_retries backoff must absorb it
+        faults.arm("fleet.spawn", "raise", count=1)
+        for _ in range(sup.dead_after_misses):
+            sup.tick()
+        stats = sup.stats()
+        assert stats["deaths"] == 1
+        assert stats["workers"] == 2
+        assert stats["spawn_failures"] == 0  # retry absorbed the fault
+        urls = fleet.worker_urls
+        assert dead_url not in urls and len(urls) == 2
+        for u in urls:
+            assert _post(u, {"x": 2.0})["scaled"] == 4.0
+    finally:
+        faults.reset()
+        sup.stop()
+        fleet.stop()
+
+
+def test_heartbeat_fault_marks_worker_dead():
+    """Armed ``fleet.heartbeat`` (probe loss, not worker death) must
+    count misses and evict after the budget — the supervisor cannot
+    tell a dead worker from an unreachable one, by design."""
+    fleet = ServingFleet(_ScaleModel(2.0), num_servers=1,
+                         max_latency_ms=1.0).start()
+    sup = FleetSupervisor(fleet, min_workers=1, max_workers=1,
+                          heartbeat_s=0.1, dead_after_misses=3)
+    try:
+        old_url = fleet.worker_urls[0]
+        faults.arm("fleet.heartbeat", "raise", count=3)
+        sup.tick()
+        sup.tick()
+        assert sup.stats()["deaths"] == 0  # under budget: not yet dead
+        sup.tick()
+        stats = sup.stats()
+        assert stats["deaths"] == 1
+        assert stats["workers"] == 1  # replaced
+        assert fleet.worker_urls[0] != old_url
+    finally:
+        faults.reset()
+        sup.stop()
+        fleet.stop()
+
+
+# -- admission control -------------------------------------------------------
+
+def test_token_bucket_sheds_hot_tenant_with_counters():
+    """An over-budget tenant sheds with 503 + Retry-After while other
+    tenants are untouched; ``admitted`` / ``shed_tenant`` counters are
+    attributed per tenant in /healthz."""
+    with env_override("MMLSPARK_TPU_SERVE_TENANT_RATE", "0.5"), \
+            env_override("MMLSPARK_TPU_SERVE_TENANT_BURST", "3"):
+        with ServingServer(_ScaleModel(2.0), max_latency_ms=1.0) as srv:
+            ok = shed = 0
+            for i in range(8):
+                try:
+                    _post(srv.url, {"x": 1.0, "__tenant__": "hot"})
+                    ok += 1
+                except urllib.error.HTTPError as e:
+                    assert e.code == 503
+                    assert int(e.headers["Retry-After"]) >= 1
+                    shed += 1
+            assert ok == 3 and shed == 5  # burst admits, then sheds
+            # another tenant (via header this time) is unaffected
+            assert _post(srv.url, {"x": 3.0},
+                         {"X-Tenant": "cool"})["scaled"] == 6.0
+            h = _get(f"http://{srv.host}:{srv.port}"
+                     "/models/default/healthz")
+            assert h["tenants"]["hot"]["admitted"] == 3
+            assert h["tenants"]["hot"]["shed_tenant"] == 5
+            assert h["tenants"]["cool"]["admitted"] == 1
+            assert h["tenants"]["cool"]["shed_tenant"] == 0
+            assert h["shed_tenant"] == 5 and h["admitted"] == 4
+            # rolling service percentiles surface for the autoscaler
+            assert h["p99_ms"] is not None
+            top = _get(f"http://{srv.host}:{srv.port}/healthz")
+            assert top["shed_tenant"] == 5
+            assert top["p99_ms"] is not None
+
+
+def test_priority_shedding_at_high_water():
+    """Past the queue high-water mark low-priority requests shed (503,
+    ``shed_priority`` counted) while high-priority requests keep
+    queueing to the hard bound."""
+    srv = ServingServer(_ScaleModel(2.0), max_latency_ms=300.0,
+                        max_queue=8, queue_high_water=1).start()
+    try:
+        results = []
+
+        def bg(i):
+            results.append(_post(srv.url, {"x": float(i)}))
+
+        # park one admitted request in the queue (the batcher waits
+        # 300 ms before scoring it)
+        t = threading.Thread(target=bg, args=(0,), daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with srv._lock:
+                if sum(len(m.queue)
+                       for m in srv._models.values()) >= 1:
+                    break
+            time.sleep(0.005)
+        # queue >= high_water: low-priority sheds ...
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(srv.url, {"x": 5.0, "__priority__": "low"})
+        assert err.value.code == 503
+        # ... via header too ...
+        with pytest.raises(urllib.error.HTTPError):
+            _post(srv.url, {"x": 5.0}, {"X-Priority": "low"})
+        # ... while high-priority (the default) is still admitted
+        t2 = threading.Thread(target=bg, args=(7,), daemon=True)
+        t2.start()
+        t.join(timeout=10)
+        t2.join(timeout=10)
+        assert sorted(r["scaled"] for r in results) == [0.0, 14.0]
+        h = srv._health()
+        assert h["shed_priority"] == 2
+        assert h["admitted"] == 2
+    finally:
+        srv.stop()
+
+
+# -- teardown hygiene --------------------------------------------------------
+
+def test_fleet_stop_survives_worker_stop_failure():
+    """One worker's stop() raising must not leak the registry thread
+    or the other workers: everything still tears down, and the error
+    re-raises after the sweep."""
+    before = _named_serving_threads()
+    fleet = ServingFleet(_ScaleModel(2.0), num_servers=3,
+                         max_latency_ms=1.0).start()
+    bad = fleet.servers[1]
+    orig_stop = bad.stop
+
+    def exploding_stop():
+        orig_stop()
+        raise RuntimeError("injected stop failure")
+
+    bad.stop = exploding_stop
+    with pytest.raises(RuntimeError, match="injected stop failure"):
+        fleet.stop()
+    # registry is down (connection refused, not a hang) ...
+    with pytest.raises(Exception):
+        _get(fleet.registry_url, timeout=1.0)
+    # ... and no serving/fleet thread this test created is left alive
+    assert _wait_threads_gone(before) == set()
+
+
+def test_fleet_stop_idempotent_after_chaos():
+    """stop() after a chaos kill() (already-dead worker) is a no-op
+    per worker and still leaves zero threads."""
+    before = _named_serving_threads()
+    fleet = ServingFleet(_ScaleModel(2.0), num_servers=2,
+                         max_latency_ms=1.0).start()
+    fleet.servers[0].kill()
+    fleet.stop()
+    fleet.stop()  # idempotent
+    assert _wait_threads_gone(before) == set()

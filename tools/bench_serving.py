@@ -18,8 +18,16 @@ Two methodologies, selected by flag:
   "binned_active", "model", "backend"} plus a summary row with the
   binned-vs-generic QPS ratio.
 
+- ``--elastic``: sustained fleet run where offered load DOUBLES at
+  half time while a FleetSupervisor autoscales workers inside a
+  min/max envelope. Emits one ``serving_elastic`` JSON row with
+  per-phase qps + p50/p99, shed counters, and the worker-count
+  trajectory.
+
 Run: python tools/bench_serving.py [n_requests] [--cpu]
      python tools/bench_serving.py --sustained [--clients N]
+                                   [--duration S] [--cpu]
+     python tools/bench_serving.py --elastic [--clients N]
                                    [--duration S] [--cpu]
 """
 
@@ -195,6 +203,152 @@ def emit_sustained(clients=64, duration_s=10.0, model_rows=None):
     return summary
 
 
+def run_elastic(model, rows, clients=16, duration_s=12.0,
+                min_workers=1, max_workers=4, scale_p99_ms=None,
+                max_batch_size=64, max_latency_ms=2.0):
+    """Sustained fleet load where OFFERED LOAD DOUBLES mid-run: wave 1
+    (``clients`` closed-loop FleetClients) starts at t0, wave 2 (same
+    size) joins at half time. A FleetSupervisor on bench timescales
+    (fast heartbeat/cooldown) grows the fleet from ``min_workers``
+    toward ``max_workers`` as p99/queue pressure builds. Returns the
+    ``serving_elastic`` row: per-phase qps + p50/p99, shed counts, and
+    the worker-count trajectory (the ROADMAP item-4 deliverable:
+    offered load doubles, p99 stays bounded while the fleet grows)."""
+    from mmlspark_tpu.io.fleet import FleetSupervisor
+    from mmlspark_tpu.io.serving import FleetClient, ServingFleet
+
+    if scale_p99_ms is None:
+        scale_p99_ms = float(os.environ.get(
+            "BENCH_ELASTIC_SCALE_P99_MS", 25.0))
+    fleet = ServingFleet(
+        model, num_servers=min_workers, max_batch_size=max_batch_size,
+        max_latency_ms=max_latency_ms, max_queue=4 * max_batch_size,
+        request_timeout_s=5.0, max_connections=2 * clients + 8,
+        reply_col="prediction").start()
+    sup = FleetSupervisor(
+        fleet, min_workers=min_workers, max_workers=max_workers,
+        scale_p99_ms=scale_p99_ms, heartbeat_s=0.25, cooldown_s=1.0,
+        scale_streak=2, probe_timeout_s=2.0).start()
+    payloads = [{"features": row.tolist()} for row in rows[:256]]
+    total = 2 * clients
+    stop_at = [0.0]
+    wave2 = threading.Event()
+    barrier = threading.Barrier(clients + 1)
+    results = [None] * total
+
+    def client(idx):
+        fc = FleetClient(fleet.registry_url, timeout=10.0,
+                         refresh_interval_s=1.0)
+        lat, ok, shed, errs = [], 0, 0, 0
+        i = idx
+        if idx < clients:
+            barrier.wait()
+        else:
+            wave2.wait()
+        while time.perf_counter() < stop_at[0]:
+            t0 = time.perf_counter()
+            try:
+                fc.score(dict(payloads[i % len(payloads)]))
+            except RuntimeError:
+                # every worker shedding (503 rotation exhausted):
+                # honor the backpressure, then retry
+                shed += 1
+                time.sleep(0.002)
+                continue
+            except Exception:
+                errs += 1
+                continue
+            i += total
+            t1 = time.perf_counter()
+            ok += 1
+            lat.append((t1, (t1 - t0) * 1e3))
+        results[idx] = (lat, ok, shed, errs)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(total)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t_start = time.perf_counter()
+    stop_at[0] = t_start + duration_s
+    t_half = t_start + duration_s / 2
+    time.sleep(max(t_half - time.perf_counter(), 0.0))
+    wave2.set()  # offered load doubles HERE
+    for t in threads:
+        t.join(timeout=duration_s + 60)
+    wall = time.perf_counter() - t_start
+    # shed/admission counters across the final fleet (workers that
+    # died mid-run take their counters with them; supervisor stats
+    # record the deaths)
+    shed_tenant = shed_priority = rejected = 0
+    with fleet._servers_lock:
+        servers = list(fleet.servers)
+    for s in servers:
+        h = s._health()
+        shed_tenant += h.get("shed_tenant", 0)
+        shed_priority += h.get("shed_priority", 0)
+        rejected += h.get("rejected", 0)
+    sup_stats = sup.stats()
+    history = [(round(t - t_start, 2), n) for t, n in sup.history]
+    # compress to change points (first, transitions, last)
+    traj = [history[0]] if history else []
+    for prev, cur in zip(history, history[1:]):
+        if cur[1] != prev[1]:
+            traj.append(cur)
+    if history and (not traj or traj[-1] != history[-1]):
+        traj.append(history[-1])
+    sup.stop()
+    fleet.stop()
+
+    def phase(pred):
+        lat = [ms for r in results if r for t, ms in r[0] if pred(t)]
+        p50, p99 = _percentiles(lat)
+        span = duration_s / 2
+        return {"qps": round(len(lat) / span, 1), "p50_ms": p50,
+                "p99_ms": p99}
+    before = phase(lambda t: t <= t_half)
+    after = phase(lambda t: t > t_half)
+    return {
+        "metric": "serving_elastic", "mode": "elastic",
+        "clients_initial": clients, "clients_peak": total,
+        "duration_s": round(wall, 2),
+        "qps_before_double": before["qps"],
+        "qps_after_double": after["qps"],
+        "p50_ms_before": before["p50_ms"], "p99_ms_before": before["p99_ms"],
+        "p50_ms_after": after["p50_ms"], "p99_ms_after": after["p99_ms"],
+        "workers_min": min_workers, "workers_max": max_workers,
+        "workers_end": sup_stats["workers"],
+        "worker_trajectory": traj,
+        "scale_ups": sup_stats["scale_ups"],
+        "scale_downs": sup_stats["scale_downs"],
+        "worker_deaths": sup_stats["deaths"],
+        "worker_spawns": sup_stats["spawns"],
+        "shed_backpressure": sum(r[2] for r in results if r),
+        "client_errors": sum(r[3] for r in results if r),
+        "shed_tenant": shed_tenant, "shed_priority": shed_priority,
+        "rejected": rejected,
+        "scale_p99_ms": scale_p99_ms,
+        "model": MODEL_DESC,
+    }
+
+
+def emit_elastic(clients=16, duration_s=12.0, model_rows=None,
+                 extra=None, **kwargs):
+    """Run the elastic-fleet bench and print its JSON row; returns the
+    row. Shared by ``--elastic`` here and bench.py's
+    ``--serving-elastic`` (which stamps its preflight verdict via
+    ``extra``)."""
+    import jax
+
+    model, rows = model_rows if model_rows is not None else build_model()
+    row = run_elastic(model, rows, clients=clients,
+                      duration_s=duration_s, **kwargs)
+    row["backend"] = jax.default_backend()
+    row.update(extra or {})
+    print(json.dumps(row), flush=True)
+    return row
+
+
 def _arg_value(flag, default):
     if flag in sys.argv:
         return type(default)(sys.argv[sys.argv.index(flag) + 1])
@@ -216,6 +370,11 @@ def main():
     if "--sustained" in sys.argv:
         emit_sustained(clients=_arg_value("--clients", 64),
                        duration_s=_arg_value("--duration", 10.0))
+        return
+
+    if "--elastic" in sys.argv:
+        emit_elastic(clients=_arg_value("--clients", 16),
+                     duration_s=_arg_value("--duration", 12.0))
         return
 
     import urllib.request
