@@ -85,6 +85,13 @@ def probe_backend(attempt_timeout=None):
 PREFLIGHT = {"verdict": None, "detail": None}
 
 
+def _resilience_counters():
+    """(stalls, recoveries) observed so far — stamped into fit rows so
+    a run that survived a watchdog abort or dp-shrink is attributable."""
+    from mmlspark_tpu.parallel import resilience
+    return resilience.stall_count(), resilience.recovery_count()
+
+
 def classify_probe(ok, detail):
     """Attribute a backend probe outcome: a timeout is a hang (the
     BENCH_r05 signature), a device-discovery failure means no devices
@@ -231,6 +238,17 @@ def main():
         sanitizer.check_finite("bench.probe", probe)
     san_disabled_ns = ((time.perf_counter() - t0) / reps * 1e9
                        if not sanitizer.enabled() else None)
+    # same attribution for the train watchdog: its step hooks sit on
+    # the same hot path, so the disabled per-call cost is measured the
+    # same way (and any stall/recovery during the timed fit must show)
+    from mmlspark_tpu.parallel import resilience
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        resilience.step_start(0)
+        resilience.step_end()
+    wd_disabled_ns = (time.perf_counter() - t0) / reps * 1e9
+    from mmlspark_tpu.core.env import env_float
+    watchdog_mult = env_float("MMLSPARK_TPU_WATCHDOG_MULT", 0.0)
     print(json.dumps({
         "metric": "gbdt_fit_throughput_higgs28f_2M" + suffix,
         "value": round(row_trees_per_s, 3),
@@ -250,6 +268,11 @@ def main():
         "graftsan_disabled_overhead_ns": (
             round(san_disabled_ns, 1) if san_disabled_ns is not None
             else None),
+        "watchdog_mult": watchdog_mult,
+        "watchdog_disabled_overhead_ns": (
+            round(wd_disabled_ns, 1) if watchdog_mult <= 0 else None),
+        "train_stalls": resilience.stall_count(),
+        "train_recoveries": resilience.recovery_count(),
     }))
 
     # transform-throughput row: steady-state batch scoring of the
@@ -400,6 +423,8 @@ def refresh_latency_main():
             "swap_s": round(result.swap["swap_s"], 4),
             "swap_downtime_s": round(result.swap["downtime_s"], 4),
             "generation": result.generation,
+            "train_stalls": _resilience_counters()[0],
+            "train_recoveries": _resilience_counters()[1],
         }))
         ctrl.close()
 

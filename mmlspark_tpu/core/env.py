@@ -236,6 +236,29 @@ BENCH_PROBE_TIMEOUT_S = register(
 BENCH_PROBE_ATTEMPTS = register(
     "MMLSPARK_TPU_BENCH_PROBE_ATTEMPTS", "int", 6,
     "bench.py: max TPU backend probe attempts before falling back")
+WATCHDOG_MULT = register(
+    "MMLSPARK_TPU_WATCHDOG_MULT", "float", 0.0,
+    "train-step watchdog: stall budget multiplier over the rolling p99 "
+    "step time (budget = max(p99 * MULT, WATCHDOG_MIN_S)); 0 disables "
+    "the watchdog (default — disabled hooks cost one None check)")
+WATCHDOG_MIN_S = register(
+    "MMLSPARK_TPU_WATCHDOG_MIN_S", "float", 60.0,
+    "train-step watchdog: floor on the stall budget in seconds; must "
+    "exceed the longest legitimate sync span (a fused-scan fit lands "
+    "nearly all compute in the final drain span)")
+WATCHDOG_INIT_S = register(
+    "MMLSPARK_TPU_WATCHDOG_INIT_S", "float", 0.0,
+    "fixed stall budget in seconds for each distributed_init attempt "
+    "(the BENCH_r05 hang shape); expiry raises an attributed "
+    "TrainStalled instead of hanging; 0 disables (default)")
+RECOVERY_MAX = register(
+    "MMLSPARK_TPU_RECOVERY_MAX", "int", 2,
+    "fit_resilient: maximum dp-shrink recovery attempts before the "
+    "original error is re-raised")
+RECOVERY_MIN_DP = register(
+    "MMLSPARK_TPU_RECOVERY_MIN_DP", "int", 1,
+    "fit_resilient: smallest dp slice worth re-forming; a failure at "
+    "this size is re-raised instead of recovered")
 
 
 _WARNED: Set[str] = set()

@@ -90,6 +90,19 @@ KNOWN_POINTS: Dict[str, str] = {
                            "mid-batch (no flush, connections reset) to "
                            "prove fleet failover and supervised "
                            "restart",
+    "mesh.collective_hang": "host sync boundary of a cross-replica "
+                            "reduction (trainer metric sync, DL epoch "
+                            "loss fetch) — an armed delay simulates a "
+                            "collective that never completes; the "
+                            "train watchdog must abort with a "
+                            "collective-stall attribution instead of "
+                            "hanging",
+    "train.participant_loss": "trainer step loops (GBDT + DL), once "
+                              "per dispatched step — armed, a mesh "
+                              "participant is lost mid-fit; "
+                              "fit_resilient must re-form the mesh on "
+                              "the surviving dp slice and resume from "
+                              "the last segment checkpoint bitwise",
 }
 
 _VALID_ACTIONS = ("raise", "delay", "corrupt")

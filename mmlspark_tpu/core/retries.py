@@ -79,11 +79,13 @@ def with_retries(fn: Callable, *, policy: Optional[RetryPolicy] = None,
     fixed = getattr(policy, "_fixed", None)
     start = time.monotonic()
     last: Optional[BaseException] = None
+    attempts = 0
     for attempt in range(1, max(policy.max_attempts, 1) + 1):
         try:
             return fn()
         except retry_on as e:  # noqa: PERF203 — retry loop by design
             last = e
+            attempts = attempt
             if should_retry is not None and not should_retry(e):
                 raise
             if attempt >= policy.max_attempts:
@@ -103,8 +105,30 @@ def with_retries(fn: Callable, *, policy: Optional[RetryPolicy] = None,
                         describe, type(e).__name__, e, attempt,
                         policy.max_attempts - 1, delay)
             sleep(delay)
+    assert last is not None
+    elapsed = time.monotonic() - start
+    detail = (f"{describe}: gave up after {attempts}/{policy.max_attempts} "
+              f"attempts in {elapsed:.2f}s"
+              + (f" (deadline {policy.deadline:.2f}s)"
+                 if policy.deadline is not None else ""))
     warn_once(f"retry.exhausted.{describe}",
               "%s failed after %d attempts; giving up (last error: %s)",
-              describe, policy.max_attempts, last)
-    assert last is not None
+              describe, attempts, last)
+    _annotate(last, detail)
     raise last
+
+
+def _annotate(exc: BaseException, detail: str) -> None:
+    """Append retry attribution to ``exc``'s message in place, keeping
+    the original exception type so callers' ``except`` clauses (and a
+    ``TrainStalled`` wrapping a retried ``distributed_init``) still
+    match — the *why it gave up* travels with the error."""
+    try:
+        if not exc.args:
+            exc.args = (detail,)
+        elif len(exc.args) == 1 and isinstance(exc.args[0], str):
+            exc.args = (f"{exc.args[0]} [{detail}]",)
+        elif hasattr(exc, "add_note"):
+            exc.add_note(detail)
+    except Exception:
+        pass

@@ -47,7 +47,8 @@ __all__ = [
     "SanitizerError", "NonFiniteError", "CollectiveDivergence",
     "RecompileBudgetExceeded", "enabled", "enable", "disable",
     "refresh_from_env", "reset", "check_finite", "record_collective",
-    "CollectiveRecorder", "recorder", "use_recorder", "step_boundary",
+    "CollectiveRecorder", "recorder", "use_recorder", "last_collective",
+    "step_boundary",
     "crosscheck_hashes", "count_recompile", "recompile_count",
     "set_recompile_budget",
 ]
@@ -191,6 +192,10 @@ class CollectiveRecorder:
         with self._lock:
             self.events.append(event)
 
+    def last(self) -> Optional[Tuple[str, str, tuple, str]]:
+        with self._lock:
+            return self.events[-1] if self.events else None
+
     def sequence_hash(self) -> str:
         with self._lock:
             blob = repr(self.events).encode("utf-8")
@@ -217,6 +222,15 @@ _active_recorder: Optional[CollectiveRecorder] = None
 
 def recorder() -> CollectiveRecorder:
     return _active_recorder if _active_recorder is not None else _recorder
+
+
+def last_collective() -> Optional[Tuple[str, str, tuple, str]]:
+    """Most recent collective event recorded on the active recorder, or
+    None — the train watchdog's stall report uses this to attribute a
+    collective-stall to the last traced op (only populated when
+    graftsan is enabled, since :func:`record_collective` fires at trace
+    time behind the ``_enabled`` gate)."""
+    return recorder().last()
 
 
 @contextmanager

@@ -22,8 +22,10 @@ from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.core.param import (
     HasLabelCol, HasPredictionCol, Param, gt, to_float, to_int, to_str,
 )
+from mmlspark_tpu.core.faults import fault_point
 from mmlspark_tpu.core.pipeline import Estimator, Model
 from mmlspark_tpu.core.timer import StopWatch
+from mmlspark_tpu.parallel import resilience
 from mmlspark_tpu.parallel.mesh import DATA_AXIS, default_mesh
 
 
@@ -47,7 +49,11 @@ def _fetch_epoch_loss(loss_acc, steps: int) -> float:
 
     if loss_acc is None:
         return float("nan")
-    return float(jax.device_get(loss_acc)) / max(steps, 1)
+    # host boundary of the epoch's gradient/loss collectives — a hang
+    # here is a collective-stall for the train watchdog
+    fault_point("mesh.collective_hang")
+    with resilience.boundary("collective", "dl epoch loss fetch"):
+        return float(jax.device_get(loss_acc)) / max(steps, 1)
 
 
 class DeepEstimator(Estimator, _DeepParams):
@@ -189,7 +195,9 @@ class DeepEstimator(Estimator, _DeepParams):
         watch = StopWatch()
         history: List[float] = []
         prefetch_async = resolve_prefetch_depth() > 0
-        with watch.measure():
+        leaked_thread = None
+        with watch.measure(), resilience.fit_watchdog("dl.train"):
+            step_no = 0
             for _ in range(self.get("maxEpochs")):
                 order = nrng.permutation(len(x))
                 # device-side loss accumulation: the only host sync per
@@ -200,12 +208,21 @@ class DeepEstimator(Estimator, _DeepParams):
                                      label=f"{label}.fit") as pf:
                     prefetch_async = prefetch_async and pf.async_mode
                     for xb, yb in pf:
+                        resilience.step_start(step_no)
+                        fault_point("train.participant_loss")
                         params, opt_state, loss = train_step(
                             params, opt_state, xb, yb)
                         loss_acc = (loss if loss_acc is None
                                     else loss_acc + loss)
+                        resilience.step_end()
+                        step_no += 1
+                # stats() is read after close() so a leaked producer
+                # (join timeout) is visible in the fit metadata
+                leaked_thread = pf.stats()["leaked_thread"] or leaked_thread
+                resilience.step_start("epoch_sync")
                 history.append(_fetch_epoch_loss(loss_acc,
                                                  steps_per_epoch))
+                resilience.step_end()
         model = self._make_model(module, jax.device_get(params), classes)
         model.train_seconds = watch.elapsed
         model.loss_history = history
@@ -218,6 +235,7 @@ class DeepEstimator(Estimator, _DeepParams):
             "opt_state_bytes_replicated": opt_bytes_full,
             "prefetch": "on" if prefetch_async else "off",
             "prefetch_depth": resolve_prefetch_depth(),
+            "prefetch_leaked_thread": leaked_thread,
         }
         return model
 

@@ -39,6 +39,7 @@ from mmlspark_tpu.core.env import (env_flag, env_int, env_override,
                                    env_raw, env_str)
 from mmlspark_tpu.core import sanitizer
 from mmlspark_tpu.core.faults import fault_point
+from mmlspark_tpu.parallel import resilience
 from mmlspark_tpu.models.gbdt import metrics as metrics_mod
 from mmlspark_tpu.models.gbdt import objectives as obj_mod
 from mmlspark_tpu.models.gbdt.booster import BoosterArrays
@@ -562,7 +563,8 @@ def _native_hist_primitive():
         # exists to avoid), a corrupt simulates bad kernel output
         fault_point("native.callback")
         from mmlspark_tpu.native import bindings
-        return bindings.level_histogram(bn, g, h, lv, lo, width, n_bins)
+        with resilience.boundary("host_callback", "native.level_histogram"):
+            return bindings.level_histogram(bn, g, h, lv, lo, width, n_bins)
 
     def _abstract(binned, grad, hess, live, local, *, width, n_bins):
         return jcore.ShapedArray((width, binned.shape[1], n_bins, 3),
@@ -622,9 +624,10 @@ def _native_level_histogram(binned, grad, hess, live, local, width, f, b):
     def _cb(bn, g, h, lv, lo, _w=width, _b=b):
         fault_point("native.callback")
         from mmlspark_tpu.native import bindings
-        return bindings.level_histogram(np.asarray(bn), np.asarray(g),
-                                        np.asarray(h), np.asarray(lv),
-                                        np.asarray(lo), _w, _b)
+        with resilience.boundary("host_callback", "native.level_histogram"):
+            return bindings.level_histogram(np.asarray(bn), np.asarray(g),
+                                            np.asarray(h), np.asarray(lv),
+                                            np.asarray(lo), _w, _b)
 
     # under shard_map the per-shard result varies over whatever mesh
     # axes the inputs vary over; declare the union when this jax
@@ -712,15 +715,16 @@ def _native_hist_primitive_v2():
              quant, has_token):
         fault_point("native.callback")
         from mmlspark_tpu.native import bindings
-        bn = (_host_binned_lookup(int(np.asarray(first))) if has_token
-              else np.asarray(first))
-        if quant == "off":
-            return bindings.level_histogram(bn, g, h, lv, lo, width,
-                                            n_bins)
-        gsi, hsi = scales
-        return bindings.level_histogram_quant(
-            bn, g, h, lv, lo, width, n_bins,
-            float(np.asarray(gsi)), float(np.asarray(hsi)))
+        with resilience.boundary("host_callback", "native.level_histogram"):
+            bn = (_host_binned_lookup(int(np.asarray(first))) if has_token
+                  else np.asarray(first))
+            if quant == "off":
+                return bindings.level_histogram(bn, g, h, lv, lo, width,
+                                                n_bins)
+            gsi, hsi = scales
+            return bindings.level_histogram_quant(
+                bn, g, h, lv, lo, width, n_bins,
+                float(np.asarray(gsi)), float(np.asarray(hsi)))
 
     def _abstract(first, g, h, lv, lo, *scales, width, n_bins,
                   num_features, quant, has_token):
@@ -776,12 +780,13 @@ def _native_level_histogram_v2(binned, grad, hess, live, local, width,
     def _cb(*args, _w=width, _b=b, _q=quant, _tok=token is not None):
         fault_point("native.callback")
         from mmlspark_tpu.native import bindings
-        host = [np.asarray(a) for a in args]
-        bn = _host_binned_lookup(int(host[0])) if _tok else host[0]
-        if _q == "off":
-            return bindings.level_histogram(bn, *host[1:5], _w, _b)
-        return bindings.level_histogram_quant(
-            bn, *host[1:5], _w, _b, float(host[5]), float(host[6]))
+        with resilience.boundary("host_callback", "native.level_histogram"):
+            host = [np.asarray(a) for a in args]
+            bn = _host_binned_lookup(int(host[0])) if _tok else host[0]
+            if _q == "off":
+                return bindings.level_histogram(bn, *host[1:5], _w, _b)
+            return bindings.level_histogram_quant(
+                bn, *host[1:5], _w, _b, float(host[5]), float(host[6]))
 
     from mmlspark_tpu.core.jax_compat import (operand_vma,
                                               shape_dtype_struct)
@@ -2342,25 +2347,26 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
                     f"group ids (pass 4-tuples in valid_sets)")
 
     try:
-        if (cfg.boosting_type == "dart" or custom_objective is not None
-                or grow_policy == "leafwise"):
-            trees, tree_weights, evals, best_iter = _train_loop(
-                cfg, k, num_f, total_bins, depth, binned_d, labels_d,
-                weights_d, group_ids_dev, raw, valid_states,
-                custom_objective, mesh, metric_name, metric_list,
-                higher_better, metric_kwargs, base_score, callbacks,
-                measures, n, row_valid, iteration_offset,
-                group_layout=group_layout, hist_token=hist_token_d,
-                binned_hist=binned_hist_d, efb_plan=efb_plan,
-                leafwise=grow_policy == "leafwise")
-        else:
-            trees, tree_weights, evals, best_iter = _train_scan(
-                cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
-                group_ids_dev, raw, valid_states, mesh,
-                metric_list, higher_better, base_score, callbacks,
-                measures, row_valid_d, iteration_offset,
-                group_layout=group_layout, hist_token=hist_token_d,
-                binned_hist=binned_hist_d, efb_plan=efb_plan)
+        with resilience.fit_watchdog("gbdt.train"):
+            if (cfg.boosting_type == "dart" or custom_objective is not None
+                    or grow_policy == "leafwise"):
+                trees, tree_weights, evals, best_iter = _train_loop(
+                    cfg, k, num_f, total_bins, depth, binned_d, labels_d,
+                    weights_d, group_ids_dev, raw, valid_states,
+                    custom_objective, mesh, metric_name, metric_list,
+                    higher_better, metric_kwargs, base_score, callbacks,
+                    measures, n, row_valid, iteration_offset,
+                    group_layout=group_layout, hist_token=hist_token_d,
+                    binned_hist=binned_hist_d, efb_plan=efb_plan,
+                    leafwise=grow_policy == "leafwise")
+            else:
+                trees, tree_weights, evals, best_iter = _train_scan(
+                    cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
+                    group_ids_dev, raw, valid_states, mesh,
+                    metric_list, higher_better, base_score, callbacks,
+                    measures, row_valid_d, iteration_offset,
+                    group_layout=group_layout, hist_token=hist_token_d,
+                    binned_hist=binned_hist_d, efb_plan=efb_plan)
     finally:
         # the loops drain every dispatched step before returning
         # (block_until_ready / eager device_get) — except when a step
@@ -2526,11 +2532,19 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
         if upto > len(met_host):
             # host boundary of the cross-replica metric reduction: the
             # device_get below is where an allreduce failure would
-            # surface, so the injection point lives here
+            # surface, so the injection point lives here — and a hang
+            # here is what the watchdog classifies as collective-stall
             fault_point("allreduce")
-            stacked = jnp.stack([outs[i][4] for i in
-                                 range(len(met_host), upto)])
-            rows = np.asarray(jax.device_get(stacked))
+            prev_b = resilience.mark_boundary(
+                "collective",
+                lambda: f"gbdt metric sync through iter {upto}")
+            try:
+                fault_point("mesh.collective_hang")
+                stacked = jnp.stack([outs[i][4] for i in
+                                     range(len(met_host), upto)])
+                rows = np.asarray(jax.device_get(stacked))
+            finally:
+                resilience.restore_boundary(prev_b)
             met_host.extend(rows)
             # first host sync after the reduced metrics land: guard
             # them and cross-check the collective-sequence hash here
@@ -2569,7 +2583,9 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
         # step): arming a raise here is the deterministic stand-in for
         # a preempted worker mid-fit — the kill-and-resume parity test
         # interrupts exactly here and resumes from the last checkpoint
+        resilience.step_start(it + iteration_offset)
         fault_point("gbdt.train_step")
+        fault_point("train.participant_loss")
         with measures.phase("training"):
             carry, ys = step_fn(data, carry, it + iteration_offset)
             outs.append(ys)
@@ -2601,6 +2617,7 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
                     sync_metrics_through(it)
                 if feed_stop_rule(it):
                     break
+        resilience.step_end()
 
     kept = outs[:stop_after]
     trees_sf: List[np.ndarray] = []
@@ -2614,6 +2631,10 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
         return ((trees_sf, trees_tb, trees_nv, trees_cnt, trees_dt,
                  trees_bgl), [], evals, best_iter)
     has_cat = len(kept[0]) > 5
+    # the fused loop dispatches steps asynchronously, so nearly all
+    # device compute lands in this drain — the watchdog times it as one
+    # span (the MIN_S floor must cover it; see PARAMS.md)
+    resilience.step_start("drain")
     with measures.phase("training"):
         jax.block_until_ready(carry)  # drain async dispatches
     # jit-boundary exit guard: raw scores after the last fused step
@@ -2630,6 +2651,7 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
             dt_h, bgl_h = jax.device_get((
                 jnp.stack([o[5] for o in kept]),
                 jnp.stack([o[6] for o in kept])))
+    resilience.step_end()
 
     for j in range(stop_after):
         for cls in range(k):
@@ -2726,7 +2748,9 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
     bag_mask = rv_host.copy()
     for it in range(cfg.num_iterations):
         # same per-iteration injection point as the fused path
+        resilience.step_start(it + iteration_offset)
         fault_point("gbdt.train_step")
+        fault_point("train.participant_loss")
         # ----- sampling masks (host RNG, deterministic by seed) ----------
         if (cfg.bagging_freq > 0
                 and (cfg.bagging_fraction < 1.0 or pos_neg)
@@ -2867,6 +2891,11 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
 
         # ----- eval + early stopping -------------------------------------
         with measures.phase("validation"):
+            # host boundary of the per-iteration metric sync (the
+            # float() casts block on cross-replica reductions)
+            prev_b = resilience.mark_boundary(
+                "collective", lambda: f"gbdt eager metric eval iter {it}")
+            fault_point("mesh.collective_hang")
             record: Dict[str, float] = {"iteration": it}
             for m_label, m_fn in metric_list:
                 mkw = dict(metric_kwargs)
@@ -2881,6 +2910,7 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
                     record[f"valid{vi}_{m_label}"] = float(
                         m_fn(vs["raw"], vs["labels"], vs["weights"], **vkw))
             evals.append(record)
+            resilience.restore_boundary(prev_b)
         for cb in (callbacks or []):
             cb(it, record)
 
@@ -2897,6 +2927,7 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
                 rounds_no_improve += 1
                 if rounds_no_improve >= cfg.early_stopping_round:
                     break
+        resilience.step_end()
 
     return ((trees_sf, trees_tb, trees_nv, trees_cnt, trees_dt, trees_bgl),
             tree_weights, evals, best_iter)

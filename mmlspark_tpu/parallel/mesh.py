@@ -105,6 +105,40 @@ def create_mesh(config: Optional[MeshConfig] = None,
     return jax.sharding.Mesh(dev_array, names)
 
 
+def shrink_mesh(mesh, keep_dp: Optional[int] = None,
+                lost_ranks: Sequence[int] = ()):
+    """Re-form a mesh on a surviving slice of its ``dp`` axis.
+
+    The elastic-recovery half of the resilience story: after a
+    participant loss or an attributed stall, ``fit_resilient`` shrinks
+    the data-parallel axis to the survivors and resumes from the last
+    segment checkpoint. Either pass ``keep_dp`` (keep the first N dp
+    coordinates) or ``lost_ranks`` (dp coordinates to drop). Returns
+    the input mesh unchanged when nothing shrinks. The checkpoint
+    fingerprint excludes the mesh, so segments fit before the shrink
+    load cleanly on the re-formed mesh and the resumed fit is
+    bitwise-identical to a deliberate elastic continuation with the
+    same mesh schedule.
+    """
+    import jax
+
+    if DATA_AXIS not in mesh.axis_names:
+        raise ValueError(f"mesh has no '{DATA_AXIS}' axis: "
+                         f"{mesh.axis_names}")
+    di = list(mesh.axis_names).index(DATA_AXIS)
+    dp = mesh.devices.shape[di]
+    if lost_ranks:
+        surviving = [r for r in range(dp) if r not in set(lost_ranks)]
+    else:
+        surviving = list(range(dp if keep_dp is None else keep_dp))
+    if not surviving:
+        raise ValueError("no surviving dp ranks to re-form the mesh on")
+    if len(surviving) == dp:
+        return mesh
+    dev_array = np.take(mesh.devices, surviving, axis=di)
+    return jax.sharding.Mesh(dev_array, mesh.axis_names)
+
+
 def distributed_init(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None,
@@ -227,10 +261,17 @@ def _init_with_retries(init_fn, fault_point) -> None:
     mis-use errors (double init, bad arguments) never retry."""
     from mmlspark_tpu.core.env import env_int
     from mmlspark_tpu.core.retries import RetryPolicy, with_retries
+    from mmlspark_tpu.parallel.resilience import stall_guard
 
     def attempt():
-        fault_point("distributed.init")
-        init_fn()
+        # MMLSPARK_TPU_WATCHDOG_INIT_S > 0 bounds each rendezvous
+        # attempt — the BENCH_r05 failure shape is an init that never
+        # returns, which no retry policy can see without this; a
+        # TrainStalled attempt retries like any transient failure and
+        # the exhaustion annotation says why the init gave up
+        with stall_guard("distributed.init"):
+            fault_point("distributed.init")
+            init_fn()
 
     def should_retry(e: BaseException) -> bool:
         if isinstance(e, (ValueError, TypeError)):

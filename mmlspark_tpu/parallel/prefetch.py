@@ -25,6 +25,9 @@ import queue
 import threading
 from typing import Any, Callable, Iterable, Iterator, Optional
 
+from mmlspark_tpu.core.logging_utils import warn_once
+from mmlspark_tpu.parallel import resilience
+
 _SENTINEL_DONE = object()
 
 
@@ -53,6 +56,8 @@ class BatchPrefetcher:
     prefetcher is closed.
     """
 
+    _join_timeout = 10.0  # seconds; tests shrink it to force the leak path
+
     def __init__(self, source: Iterable, place_fn: Optional[Callable] = None,
                  depth: Optional[int] = None, label: str = "prefetch"):
         self.label = label
@@ -63,6 +68,7 @@ class BatchPrefetcher:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._closed = False
+        self._leaked_thread: Optional[str] = None
         if self.depth > 0:
             self._queue = queue.Queue(maxsize=self.depth)
             self._thread = threading.Thread(
@@ -117,16 +123,24 @@ class BatchPrefetcher:
             except StopIteration:
                 self.close()
                 raise
-        while True:
-            try:
-                item = self._queue.get(timeout=0.1)
-                break
-            except queue.Empty:
-                if self._thread is not None and not self._thread.is_alive():
-                    # producer died without delivering its sentinel
-                    # (should not happen; never hang the fit on it)
-                    self.close()
-                    raise StopIteration
+        prev = resilience.mark_boundary(
+            "input_wait",
+            lambda: f"{self.label}: queue {self._queue.qsize()}/"
+                    f"{self.depth} staged, producer "
+                    f"{'alive' if self._thread is not None and self._thread.is_alive() else 'dead'}")
+        try:
+            while True:
+                try:
+                    item = self._queue.get(timeout=0.1)
+                    break
+                except queue.Empty:
+                    if self._thread is not None and not self._thread.is_alive():
+                        # producer died without delivering its sentinel
+                        # (should not happen; never hang the fit on it)
+                        self.close()
+                        raise StopIteration
+        finally:
+            resilience.restore_boundary(prev)
         if item is _SENTINEL_DONE:
             self.close()
             raise StopIteration
@@ -149,8 +163,28 @@ class BatchPrefetcher:
             except queue.Empty:
                 pass
         if self._thread is not None:
-            self._thread.join(timeout=10.0)
+            self._thread.join(timeout=self._join_timeout)
+            if self._thread.is_alive():
+                # the join timed out: the producer is wedged (most
+                # likely inside place_fn) and its daemon thread leaks —
+                # say so instead of silently dropping the handle
+                self._leaked_thread = self._thread.name
+                warn_once(
+                    f"prefetch.leaked_thread.{self._thread.name}",
+                    "prefetcher %s: producer thread %r did not stop "
+                    "within %.1fs of close(); leaking it as a daemon",
+                    self.label, self._thread.name, self._join_timeout)
             self._thread = None
+
+    def stats(self) -> dict:
+        """Observability snapshot: queue depth/occupancy and whether
+        close() leaked the producer thread (None = clean)."""
+        return {
+            "label": self.label,
+            "depth": self.depth,
+            "queued": self._queue.qsize() if self._queue is not None else 0,
+            "leaked_thread": self._leaked_thread,
+        }
 
     def __enter__(self) -> "BatchPrefetcher":
         return self

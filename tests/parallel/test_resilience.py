@@ -1,0 +1,245 @@
+"""Training-resilience chaos drills (parallel/resilience.py).
+
+The pinned contracts:
+  - an armed ``mesh.collective_hang`` delay aborts the fit within the
+    watchdog budget with a collective-stall classification — never an
+    indefinite hang — and with the watchdog off (the default) the same
+    delay completes normally, bitwise-identical to an undelayed fit;
+  - a fit killed mid-ensemble by a participant loss resumes through
+    ``fit_resilient`` on a dp-shrunk mesh, bitwise-identical to an
+    uninterrupted *elastic* run with the same mesh schedule (segments
+    before the loss at the original dp, after at the shrunken dp, via
+    the standard checkpoint continue);
+  - the disabled step hooks cost ~ns (fault_point-style one-boolean
+    guard), so default fits are bit-identical to pre-watchdog builds.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.env import env_override
+from mmlspark_tpu.core.retries import RetryPolicy, with_retries
+from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+from mmlspark_tpu.parallel import resilience
+from mmlspark_tpu.parallel.mesh import (MeshConfig, axis_size, create_mesh,
+                                        shrink_mesh)
+from mmlspark_tpu.parallel.prefetch import BatchPrefetcher
+from mmlspark_tpu.parallel.resilience import (ParticipantLost, TrainStalled,
+                                              TrainWatchdog, fit_resilient,
+                                              stall_guard)
+
+pytestmark = pytest.mark.resilience_smoke
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.reset()
+    resilience.reset()
+    yield
+    faults.reset()
+    resilience.reset()
+
+
+def _df(n=256, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f))
+    y = x @ rng.normal(size=f) + 0.1 * rng.normal(size=n)
+    return DataFrame({"features": x, "label": y})
+
+
+def _mesh(dp):
+    import jax
+    return create_mesh(MeshConfig(dp=dp), devices=jax.devices()[:dp])
+
+
+def _est(iters=4):
+    return LightGBMRegressor(numIterations=iters, numLeaves=7, maxBin=32,
+                             seed=3)
+
+
+class TestWatchdog:
+    def test_disabled_overhead_is_noise(self):
+        """The step hooks ride every train iteration unconditionally;
+        disabled they must be one module-global check (same budget as
+        the graftsan guard: well under 5 µs/call even on a loaded CI
+        box; typical is tens of ns)."""
+        assert resilience._active is None
+        reps = 50_000
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            resilience.step_start(0)
+            resilience.step_end()
+        per_call_ns = (time.perf_counter() - t0) / reps * 1e9
+        assert per_call_ns < 5_000
+
+    def test_collective_hang_aborts_within_budget(self):
+        """A 30s collective hang aborts in well under a second once the
+        0.3s budget expires, classified from the marked boundary."""
+        df = _df()
+        # warm the compile cache first: the budget floor must only
+        # cover steady-state spans, not first-call jit compilation
+        # (production sets WATCHDOG_MIN_S above the longest legit span)
+        _est().fit(df)
+        t0 = time.monotonic()
+        with env_override("MMLSPARK_TPU_WATCHDOG_MULT", "4"), \
+                env_override("MMLSPARK_TPU_WATCHDOG_MIN_S", "0.3"):
+            with faults.injected("mesh.collective_hang", "delay",
+                                 delay_s=30.0):
+                with pytest.raises(TrainStalled) as ei:
+                    _est().fit(df)
+        wall = time.monotonic() - t0
+        assert wall < 15.0, f"abort took {wall:.1f}s against a 0.3s budget"
+        err = ei.value
+        assert err.classification == "collective-stall"
+        assert err.budget_s == pytest.approx(0.3)
+        assert err.elapsed_s >= 0.3
+        assert "collective-stall" in str(err)
+        assert err.report["boundary"] == "collective"
+        assert resilience.stall_count() == 1
+        # the monitor thread must not linger past the fit
+        time.sleep(0.05)
+        assert not [t for t in threading.enumerate()
+                    if t.name.startswith("graft-watchdog-")]
+
+    def test_watchdog_off_delay_completes_bitwise(self):
+        """Default env (MULT=0): the same armed delay merely slows the
+        fit; the model is bitwise-identical to an undelayed fit."""
+        df = _df()
+        ref = _est().fit(df).get_model_string()
+        with faults.injected("mesh.collective_hang", "delay",
+                             delay_s=0.2):
+            slow = _est().fit(df).get_model_string()
+        assert slow == ref
+        assert resilience.stall_count() == 0
+
+    def test_stall_guard_fixed_budget(self):
+        """stall_guard bounds a single blocking call (the
+        distributed_init shape) with a backend-hang classification."""
+        t0 = time.monotonic()
+        with pytest.raises(TrainStalled) as ei:
+            with stall_guard("init-probe", budget_s=0.2):
+                time.sleep(30.0)
+        assert time.monotonic() - t0 < 10.0
+        assert ei.value.classification == "backend-hang"
+        assert ei.value.label == "init-probe"
+
+    def test_disabled_guard_is_inert(self):
+        """budget 0 (the default WATCHDOG_INIT_S) arms nothing."""
+        with stall_guard("noop") as wd:
+            assert not wd.enabled
+        assert resilience._active is None
+
+
+class TestElasticRecovery:
+    def test_kill_mid_fit_dp_shrink_resume_bitwise(self, tmp_path):
+        """Participant lost at the first iteration of segment 3 (of a
+        6-iteration fit checkpointed every 2): fit_resilient re-forms
+        dp=4 -> dp=2 and resumes from checkpoint_4, bitwise-identical
+        to an uninterrupted elastic run with the same mesh schedule."""
+        df = _df()
+        est = _est(iters=6)
+
+        ref_dir = str(tmp_path / "ref")
+        est.copy(checkpointDir=ref_dir, checkpointInterval=2,
+                 numIterations=4).set_mesh(_mesh(4)).fit(df)
+        ref = est.copy(checkpointDir=ref_dir, checkpointInterval=2) \
+                 .set_mesh(_mesh(2)).fit(df).get_model_string()
+
+        chaos_dir = str(tmp_path / "chaos")
+        with faults.injected("train.participant_loss", "raise", nth=5,
+                             exc=ParticipantLost("rank 3 lost")):
+            out = fit_resilient(est, df, checkpoint_dir=chaos_dir,
+                                checkpoint_interval=2, mesh=_mesh(4))
+        assert out.model.get_model_string() == ref
+        assert [(r.cause, r.dp_before, r.dp_after)
+                for r in out.recoveries] == [("ParticipantLost", 4, 2)]
+        assert axis_size(out.mesh, "dp") == 2
+        assert resilience.recovery_count() == 1
+
+    def test_recovery_exhaustion_reraises(self, tmp_path):
+        """A loss that keeps firing runs out of dp to shrink (min_dp)
+        and re-raises the original error instead of looping."""
+        df = _df()
+        with faults.injected("train.participant_loss", "raise", nth=1,
+                             count=100,
+                             exc=ParticipantLost("flapping rank")):
+            with pytest.raises(ParticipantLost):
+                fit_resilient(_est(), df,
+                              checkpoint_dir=str(tmp_path / "ck"),
+                              checkpoint_interval=2, mesh=_mesh(2),
+                              min_dp=2)
+
+    def test_shrink_mesh(self):
+        m8 = _mesh(8)
+        m4 = shrink_mesh(m8, keep_dp=4)
+        assert axis_size(m4, "dp") == 4
+        assert m4.axis_names == m8.axis_names
+        np.testing.assert_array_equal(
+            np.vectorize(lambda d: d.id)(m4.devices),
+            np.vectorize(lambda d: d.id)(m8.devices)[:4])
+        m6 = shrink_mesh(m8, lost_ranks=[0, 7])
+        assert axis_size(m6, "dp") == 6
+        assert shrink_mesh(m8) is m8  # nothing to drop
+        with pytest.raises(ValueError, match="no surviving"):
+            shrink_mesh(m8, keep_dp=0)
+
+
+class TestSatellites:
+    def test_with_retries_exhaustion_attribution(self):
+        """The re-raised error carries attempts/elapsed/deadline — the
+        'why it gave up' for a TrainStalled wrapping a retried init."""
+        def boom():
+            raise ConnectionError("coordinator unreachable")
+
+        with pytest.raises(ConnectionError) as ei:
+            with_retries(boom,
+                         policy=RetryPolicy(max_attempts=3,
+                                            base_delay=0.001,
+                                            deadline=5.0),
+                         describe="unit.init", seed=0)
+        msg = str(ei.value)
+        assert "coordinator unreachable" in msg
+        assert "gave up after 3/3 attempts" in msg
+        assert "deadline 5.00s" in msg
+
+    def test_prefetch_leaked_thread_surfaced(self, caplog):
+        """close() joining past its timeout must name the leaked
+        producer in stats and warn — not silently drop the handle."""
+        import logging
+
+        release = threading.Event()
+
+        def blocking_place(b):
+            release.wait(20.0)
+            return b
+
+        pf = BatchPrefetcher(iter([1, 2, 3]), blocking_place, depth=2,
+                             label="leaktest")
+        assert pf.async_mode
+        pf._join_timeout = 0.05
+        with caplog.at_level(logging.WARNING, logger="mmlspark_tpu"):
+            pf.close()
+        stats = pf.stats()
+        assert stats["leaked_thread"] == "mmlspark-leaktest"
+        assert any("did not stop" in r.getMessage()
+                   for r in caplog.records)
+        # unwedge the producer so no thread outlives this test
+        release.set()
+        time.sleep(0.3)
+        assert not [t for t in threading.enumerate()
+                    if t.name == "mmlspark-leaktest" and t.is_alive()]
+
+    def test_prefetch_clean_close_reports_no_leak(self):
+        with BatchPrefetcher(iter([1, 2]), None, depth=2,
+                             label="cleantest") as pf:
+            assert list(pf) == [1, 2]
+        assert pf.stats()["leaked_thread"] is None
+
+    def test_fault_points_registered(self):
+        assert "mesh.collective_hang" in faults.KNOWN_POINTS
+        assert "train.participant_loss" in faults.KNOWN_POINTS
