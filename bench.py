@@ -85,6 +85,16 @@ def probe_backend(attempt_timeout=None):
 PREFLIGHT = {"verdict": None, "detail": None}
 
 
+def peak_rss_mb():
+    """Process-wide peak RSS in MB (ru_maxrss is KB on Linux) — stamped
+    into every fit-throughput row so memory regressions are visible in
+    the artifact, and the headline number for the --ooc row (whose
+    whole process IS the streamed fit)."""
+    import resource
+    return round(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                 / 1024.0, 1)
+
+
 def _resilience_counters():
     """(stalls, recoveries) observed so far — stamped into fit rows so
     a run that survived a watchdog abort or dp-shrink is attributable."""
@@ -273,6 +283,8 @@ def main():
             round(wd_disabled_ns, 1) if watchdog_mult <= 0 else None),
         "train_stalls": resilience.stall_count(),
         "train_recoveries": resilience.recovery_count(),
+        "peak_rss_mb": peak_rss_mb(),
+        **{k: result.hist_stats.get(k) for k in ("ooc", "ooc_reason")},
     }))
 
     # transform-throughput row: steady-state batch scoring of the
@@ -345,6 +357,78 @@ def main():
                      "train_shard_dp", "prefetch", "prefetch_depth",
                      "opt_state_bytes_per_device",
                      "opt_state_bytes_replicated")},
+        "peak_rss_mb": peak_rss_mb(),
+    }))
+
+
+def ooc_main():
+    """``python bench.py --ooc``: the out-of-core fit row — a streamed
+    fit over rows generated, binned and spilled chunk-by-chunk, so no
+    full-N array ever exists in this process. The process-wide
+    ``peak_rss_mb`` therefore IS the bounded-memory claim: it must stay
+    near the interpreter + jit baseline regardless of BENCH_OOC_ROWS
+    (default 4M; scale up on real hardware, down for CI rehearsals)."""
+    platform = wait_for_backend(metric="gbdt_fit_throughput_ooc",
+                                allow_cpu_fallback=True)
+    print(f"# backend up: {platform}", file=sys.stderr, flush=True)
+    import tempfile
+
+    import jax
+
+    from mmlspark_tpu.core.compile_cache import enable_persistent_cache
+    from mmlspark_tpu.models.gbdt.ooc import train_ooc
+    from mmlspark_tpu.models.gbdt.trainer import TrainConfig
+    from mmlspark_tpu.ops.binning import BinMapper
+    from mmlspark_tpu.ops.ingest import ChunkStore, SpillWriter
+
+    enable_persistent_cache()
+    n = int(os.environ.get("BENCH_OOC_ROWS", 4_000_000))
+    num_trees = int(os.environ.get("BENCH_OOC_TREES", 20))
+    f = 28  # HIGGS-shaped, as the in-core row
+    from mmlspark_tpu.models.gbdt.trainer import resolve_ooc_chunk_rows
+    chunk = resolve_ooc_chunk_rows()
+
+    def gen(i, rows):
+        r = np.random.default_rng(1000 + i)
+        x = r.normal(size=(rows, f)).astype(np.float32)
+        logit = (x[:, 0] * 1.2 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
+                 + 0.3 * np.sin(x[:, 4] * 3))
+        y = (logit + r.normal(size=rows) * 0.5 > 0).astype(np.float32)
+        return x, y
+
+    spans = [(i, s, min(chunk, n - s))
+             for i, s in enumerate(range(0, n, chunk))]
+    mapper = BinMapper.fit_streaming(
+        (gen(i, rows)[0] for i, _, rows in spans), max_bin=63)
+    cfg = TrainConfig(objective="binary", num_iterations=num_trees,
+                      num_leaves=63, max_depth=6, min_data_in_leaf=20,
+                      max_bin=63)
+    with tempfile.TemporaryDirectory(prefix="bench-ooc-") as td:
+        writer = SpillWriter(os.path.join(td, "binned"), dtype=np.uint8)
+        labels = ChunkStore(os.path.join(td, "labels"), "y")
+        for i, _, rows in spans:
+            x, y = gen(i, rows)
+            writer.append(mapper.transform(x))
+            labels.put(i, y)
+        spill = writer.finalize()
+        t0 = time.perf_counter()
+        result = train_ooc(spill, labels, cfg,
+                           work_dir=os.path.join(td, "state"))
+        dt = time.perf_counter() - t0
+    suffix = "" if (n == 4_000_000 and num_trees == 20) \
+        else f"_rows{n}_trees{num_trees}"
+    print(json.dumps({
+        "metric": "gbdt_fit_throughput_ooc" + suffix,
+        "value": round(n * result.booster.num_trees / dt / 1e6, 3),
+        "unit": "Mrow-trees/s",
+        "vs_baseline": None,  # the in-core row is the comparator
+        "backend": jax.default_backend(),
+        "backend_preflight": PREFLIGHT["verdict"],
+        "fit_s": round(dt, 3),
+        "peak_rss_mb": peak_rss_mb(),
+        **{k: result.hist_stats.get(k)
+           for k in ("ooc", "ooc_reason", "chunk_rows", "n_chunks",
+                     "hist_quant", "hist_subtract")},
     }))
 
 
@@ -425,6 +509,7 @@ def refresh_latency_main():
             "generation": result.generation,
             "train_stalls": _resilience_counters()[0],
             "train_recoveries": _resilience_counters()[1],
+            "peak_rss_mb": peak_rss_mb(),
         }))
         ctrl.close()
 
@@ -518,5 +603,7 @@ if __name__ == "__main__":
         serving_sustained_main()
     elif "--refresh-latency" in sys.argv:
         refresh_latency_main()
+    elif "--ooc" in sys.argv:
+        ooc_main()
     else:
         main()

@@ -259,6 +259,19 @@ RECOVERY_MIN_DP = register(
     "MMLSPARK_TPU_RECOVERY_MIN_DP", "int", 1,
     "fit_resilient: smallest dp slice worth re-forming; a failure at "
     "this size is re-raised instead of recovered")
+OOC = register(
+    "MMLSPARK_TPU_OOC", "str", "auto",
+    "out-of-core GBDT training: auto (engage when the row count "
+    "reaches MMLSPARK_TPU_OOC_ROWS), on (force; warn-once downgrade "
+    "to in-core when the fit shape is unsupported), off")
+OOC_ROWS = register(
+    "MMLSPARK_TPU_OOC_ROWS", "int", 4_000_000,
+    "out-of-core training: row threshold at which MMLSPARK_TPU_OOC="
+    "auto switches a supported fit to the chunked spill plane")
+OOC_CHUNK_ROWS = register(
+    "MMLSPARK_TPU_OOC_CHUNK_ROWS", "int", 262_144,
+    "out-of-core training: rows per spill chunk; peak training RSS "
+    "scales with this (chunk working set), not with the dataset")
 
 
 _WARNED: Set[str] = set()

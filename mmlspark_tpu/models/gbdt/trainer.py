@@ -425,6 +425,85 @@ def _leafwise_supported(cfg: "TrainConfig", mesh) -> Optional[str]:
     return None
 
 
+_WARNED_BAD_OOC = False
+_WARNED_OOC_DOWNGRADE = False
+
+_VALID_OOC = ("auto", "off", "on")
+
+
+def resolve_ooc(warn: bool = True) -> str:
+    """Out-of-core training policy (MMLSPARK_TPU_OOC, default auto):
+    ``auto`` streams a supported fit through the chunked spill plane
+    once the row count reaches MMLSPARK_TPU_OOC_ROWS; ``on`` forces it
+    (downgrading with one warning when the fit shape is unsupported);
+    ``off`` disables. Bad values warn once and run auto (core.env
+    contract)."""
+    global _WARNED_BAD_OOC
+    raw = (env_str("MMLSPARK_TPU_OOC", "") or "").strip().lower()
+    if not raw:
+        return "auto"
+    if raw not in _VALID_OOC:
+        if warn and not _WARNED_BAD_OOC:
+            _WARNED_BAD_OOC = True
+            import warnings
+            warnings.warn(
+                f"MMLSPARK_TPU_OOC={raw!r} is not one of auto|off|on; "
+                "using auto", stacklevel=2)
+        return "auto"
+    return raw
+
+
+def resolve_ooc_chunk_rows() -> int:
+    return env_int("MMLSPARK_TPU_OOC_CHUNK_ROWS", 262_144, minimum=1024)
+
+
+def _ooc_supported(cfg: "TrainConfig", mesh, k: int, has_valid: bool,
+                   has_custom: bool, has_groups: bool,
+                   total_bins: int) -> Optional[str]:
+    """None when the chunked out-of-core loop can reproduce this fit
+    exactly, else the human-readable reason for staying in-core.
+
+    The supported surface is the serial depthwise numeric plane whose
+    histograms merge exactly across row chunks: the native kernel's
+    integer-quantized accumulation is row-partition invariant, so a
+    chunk-merged histogram is bitwise the in-core one. Anything that
+    samples rows/features per iteration, needs resident full-N state
+    (validation scoring, lambdarank groups), or runs a different
+    builder stays in-core."""
+    if mesh is not None:
+        return "a device mesh is attached (out-of-core is single-program)"
+    if resolve_grow_policy(warn=False) == "leafwise":
+        return "leafwise growth"
+    if cfg.tree_learner in ("voting", "feature"):
+        return f"tree_learner={cfg.tree_learner!r}"
+    if cfg.boosting_type != "gbdt":
+        return f"boosting_type={cfg.boosting_type!r}"
+    if has_custom:
+        return "a custom objective"
+    if k > 1:
+        return "multiclass objectives"
+    if cfg.objective == "lambdarank" or has_groups:
+        return "lambdarank / grouped fits"
+    if has_valid or cfg.early_stopping_round > 0:
+        return "validation sets / early stopping"
+    if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
+        return "bagging"
+    if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
+        return "pos/neg bagging"
+    if cfg.feature_fraction < 1.0 or cfg.feature_fraction_by_node < 1.0:
+        return "feature sampling"
+    if cfg.extra_trees:
+        return "extra_trees"
+    if cfg.categorical_features:
+        return "categorical_features"
+    if any(cfg.monotone_constraints or ()):
+        return "monotone_constraints"
+    if resolve_histogram_formulation(total_bins, warn=False) != "native":
+        return ("the native histogram kernel is unavailable (chunk-exact "
+                "merges need its integer accumulation)")
+    return None
+
+
 _WARNED_BAD_SHARD = False
 _WARNED_SHARD_DOWNGRADE_DP = False
 
@@ -1042,6 +1121,122 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
     return hist.reshape(width, f, b, 3)
 
 
+def _leaf_objective_impl(g, h, lam1, lam2, extra_l2=0.0):
+    """L1-regularized leaf value and its score contribution.
+
+    Module-level so the out-of-core loop (models/gbdt/ooc.py) evaluates
+    the exact same expression graph as the compiled builder — a shared
+    subgraph is the cheapest bitwise-parity guarantee."""
+    import jax.numpy as jnp
+
+    g_adj = jnp.sign(g) * jnp.maximum(jnp.abs(g) - lam1, 0.0)
+    denom = h + lam2 + extra_l2 + 1e-30
+    value = -g_adj / denom
+    score = g_adj * g_adj / denom
+    return value, score
+
+
+def _derive_sibling_hist(hist_small, prev_hist, prev_split, prev_ss):
+    """Histogram-subtraction sibling derivation for one level.
+
+    ``hist_small`` (width, F, B, 3) holds real histograms only on each
+    split's smaller child; the larger sibling is parent - smaller, and
+    slots under non-split parents are zeroed. Shared between the
+    compiled builder and the out-of-core loop (bitwise-equal trees need
+    identical derive arithmetic, not just identical inputs)."""
+    import jax.numpy as jnp
+
+    width = hist_small.shape[0]
+    kids = jnp.arange(width)
+    par_idx = kids // 2
+    is_small = (kids % 2) == prev_ss[par_idx]
+    sib = hist_small[kids ^ 1]
+    parent_h = prev_hist[par_idx]
+    hist = jnp.where(
+        is_small[:, None, None, None], hist_small,
+        jnp.where(prev_split[par_idx][:, None, None, None],
+                  parent_h - sib, 0.0))
+    # float cancellation can leave tiny negative counts / hessians on
+    # the derived side; clamp for the guards
+    hist = hist.at[..., 1].max(0.0)
+    hist = hist.at[..., 2].max(0.0)
+    return hist
+
+
+def _find_numeric_splits(hist, feat_mask, remaining, parent_value, *, b,
+                         lam1, lam2, min_child, min_hess, min_gain,
+                         path_smooth, max_delta_step):
+    """Numeric-only split finding for one level: ordered cumulative scan,
+    leaf-budget ranking, and child values, from the (width, F, B, 3)
+    level histogram. ``parent_value`` is the per-slot current node value
+    (path smoothing shrinks children toward it).
+
+    Returns (do_split, best_feat, best_bin, left_mask, lval, rval,
+    left_stats, right_stats, remaining, smaller_side). This is the
+    whole split pipeline for fits with no categorical / monotone /
+    extra-trees / per-node-sampling features — the depthwise builder's
+    fast path and the out-of-core loop both call it, so the two paths
+    build bitwise-identical trees from bitwise-identical histograms.
+    """
+    import jax.numpy as jnp
+
+    width = hist.shape[0]
+    cum = jnp.cumsum(hist, axis=2)              # left stats per bin
+    tot = cum[:, :, -1:, :]
+    gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
+    gt, ht, ct = tot[..., 0], tot[..., 1], tot[..., 2]
+    gr, hr, cr = gt - gl, ht - hl, ct - cl
+    _, score_l = _leaf_objective_impl(gl, hl, lam1, lam2)
+    _, score_r = _leaf_objective_impl(gr, hr, lam1, lam2)
+    _, score_p = _leaf_objective_impl(gt, ht, lam1, lam2)
+    gain = 0.5 * (score_l + score_r - score_p)
+    ok = ((cl >= min_child) & (cr >= min_child)
+          & (hl >= min_hess) & (hr >= min_hess)
+          & (gain > min_gain))
+    node_fmask = feat_mask[None, :] > 0
+    ok &= node_fmask[:, :, None]
+    # last bin can't split (right side empty by construction)
+    ok &= jnp.arange(b)[None, None, :] < b - 1
+    gain = jnp.where(ok, gain, -jnp.inf)
+
+    flat_gain = gain.reshape(width, -1)
+    best_fb = jnp.argmax(flat_gain, axis=1)
+    best_gain = jnp.take_along_axis(flat_gain, best_fb[:, None], 1)[:, 0]
+    best_feat = (best_fb // b).astype(jnp.int32)
+    best_bin = (best_fb % b).astype(jnp.int32)
+
+    # leaf budget: within-level gain ranking
+    can_split = jnp.isfinite(best_gain)
+    order = jnp.argsort(-jnp.where(can_split, best_gain, -jnp.inf))
+    rank = jnp.zeros(width, dtype=jnp.int32).at[order].set(
+        jnp.arange(width, dtype=jnp.int32))
+    do_split = can_split & (rank < remaining)
+    remaining = remaining - jnp.sum(do_split.astype(jnp.int32))
+
+    left_mask = jnp.arange(b)[None, :] <= best_bin[:, None]
+    hist_best = hist[jnp.arange(width), best_feat]      # (width, B, 3)
+    left_stats = jnp.sum(hist_best * left_mask[..., None], axis=1)
+    tot_best = jnp.sum(hist_best, axis=1)
+    right_stats = tot_best - left_stats
+    lval, _ = _leaf_objective_impl(left_stats[:, 0], left_stats[:, 1],
+                                   lam1, lam2)
+    rval, _ = _leaf_objective_impl(right_stats[:, 0], right_stats[:, 1],
+                                   lam1, lam2)
+    if path_smooth > 0:
+        # shrink child outputs toward the parent's by n/(n+ps)
+        wl = left_stats[:, 2] / (left_stats[:, 2] + path_smooth)
+        wr = right_stats[:, 2] / (right_stats[:, 2] + path_smooth)
+        lval = lval * wl + parent_value * (1.0 - wl)
+        rval = rval * wr + parent_value * (1.0 - wr)
+    if max_delta_step > 0:
+        lval = jnp.clip(lval, -max_delta_step, max_delta_step)
+        rval = jnp.clip(rval, -max_delta_step, max_delta_step)
+    smaller_side = jnp.where(
+        left_stats[:, 2] <= right_stats[:, 2], 0, 1).astype(jnp.int32)
+    return (do_split, best_feat, best_bin, left_mask, lval, rval,
+            left_stats, right_stats, remaining, smaller_side)
+
+
 def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
                     subtract: bool = False, allow_pallas: bool = True,
                     allow_native: bool = True, efb_plan=None):
@@ -1123,14 +1318,15 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
                 f"entries but there are only {num_features} features")
         mono_np[:len(cfg.monotone_constraints)] = cfg.monotone_constraints
     has_mono = bool(mono_np.any())
+    # numeric-only fast path: split math delegates to the module-level
+    # _find_numeric_splits shared with the out-of-core loop, so both
+    # build bitwise-identical trees from identical histograms
+    simple_numeric = (not has_cat and not has_mono and not cfg.extra_trees
+                      and cfg.feature_fraction_by_node >= 1.0)
 
     def leaf_objective(g, h, extra_l2=0.0):
         # L1-regularized leaf value and its score contribution
-        g_adj = jnp.sign(g) * jnp.maximum(jnp.abs(g) - lam1, 0.0)
-        denom = h + lam2 + extra_l2 + 1e-30
-        value = -g_adj / denom
-        score = g_adj * g_adj / denom
-        return value, score
+        return _leaf_objective_impl(g, h, lam1, lam2, extra_l2)
 
     def build_tree(binned, grad, hess, valid, feat_mask, remaining_leaves,
                    key=None, hist_token=None, binned_hist=None):
@@ -1244,14 +1440,20 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
         # a constrained split may not cross the split midpoint
         node_lower = jnp.full(num_slots, -jnp.inf, dtype=jnp.float32)
         node_upper = jnp.full(num_slots, jnp.inf, dtype=jnp.float32)
-        # root stats
-        root_g, root_h, root_c = (jnp.sum(grad * valid), jnp.sum(hess * valid),
-                                  jnp.sum(valid))
-        rv, _ = leaf_objective(root_g, root_h)
-        if cfg.max_delta_step > 0:
-            rv = jnp.clip(rv, -cfg.max_delta_step, cfg.max_delta_step)
-        node_value = node_value.at[0].set(rv)
-        node_count = node_count.at[0].set(root_c)
+        # root stats: exact-plane fits reduce grad/hess directly; the
+        # quantized plane instead derives them from the level-0
+        # histogram totals (below, inside the loop) — bin sums of the
+        # exact integer accumulation — so a chunk-merged out-of-core
+        # histogram reproduces the root bitwise too
+        if hist_quant == "off":
+            root_g, root_h, root_c = (jnp.sum(grad * valid),
+                                      jnp.sum(hess * valid),
+                                      jnp.sum(valid))
+            rv, _ = leaf_objective(root_g, root_h)
+            if cfg.max_delta_step > 0:
+                rv = jnp.clip(rv, -cfg.max_delta_step, cfg.max_delta_step)
+            node_value = node_value.at[0].set(rv)
+            node_count = node_count.at[0].set(root_c)
 
         remaining = remaining_leaves - 1  # root is one leaf
 
@@ -1292,24 +1494,67 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
                     hist_small = _hist(
                         binned_pad[idx], grad_pad[idx], hess_pad[idx],
                         live_pad[idx], local_pad[idx], width)
-                kids = jnp.arange(width)
-                par_idx = kids // 2
-                is_small = (kids % 2) == prev_ss[par_idx]
-                sib = hist_small[kids ^ 1]
-                parent_h = prev_hist[par_idx]
-                hist = jnp.where(
-                    is_small[:, None, None, None], hist_small,
-                    jnp.where(prev_split[par_idx][:, None, None, None],
-                              parent_h - sib, 0.0))
-                # float cancellation can leave tiny negative counts /
-                # hessians on the derived side; clamp for the guards
-                hist = hist.at[..., 1].max(0.0)
-                hist = hist.at[..., 2].max(0.0)
+                hist = _derive_sibling_hist(hist_small, prev_hist,
+                                            prev_split, prev_ss)
             else:
                 hist = _hist(hist_mat, grad_h, hess_h, live, local,
                              width)
             if subtract:
                 prev_hist = hist
+            if hist_quant != "off" and d == 0:
+                # quantized-plane root stats from the level-0 histogram
+                # (any one feature's bins partition the live rows);
+                # recorded before split finding so path smoothing sees
+                # the root value at this level
+                tot0 = jnp.sum(hist[0, 0], axis=0)
+                rv0, _ = leaf_objective(tot0[0], tot0[1])
+                if cfg.max_delta_step > 0:
+                    rv0 = jnp.clip(rv0, -cfg.max_delta_step,
+                                   cfg.max_delta_step)
+                node_value = node_value.at[0].set(rv0)
+                node_count = node_count.at[0].set(tot0[2])
+
+            slots = level_start + jnp.arange(width)
+            if simple_numeric:
+                (do_split, best_feat, best_bin, left_mask, lval, rval,
+                 left_stats, right_stats, remaining, small_side) = \
+                    _find_numeric_splits(
+                        hist, feat_mask, remaining, node_value[slots],
+                        b=b, lam1=lam1, lam2=lam2, min_child=min_child,
+                        min_hess=min_hess, min_gain=min_gain,
+                        path_smooth=cfg.path_smooth,
+                        max_delta_step=cfg.max_delta_step)
+                split_feature = split_feature.at[slots].set(
+                    jnp.where(do_split, best_feat, -1))
+                threshold_bin = threshold_bin.at[slots].set(
+                    jnp.where(do_split, best_bin, 0))
+                num_bits = 6 if cfg.zero_as_missing else 10
+                decision_type = decision_type.at[slots].set(
+                    jnp.where(do_split, num_bits, 0).astype(jnp.int8))
+                bin_go_left = bin_go_left.at[slots].set(
+                    left_mask & do_split[:, None])
+                lslots, rslots = 2 * slots + 1, 2 * slots + 2
+                node_value = node_value.at[lslots].set(
+                    jnp.where(do_split, lval, 0.0))
+                node_value = node_value.at[rslots].set(
+                    jnp.where(do_split, rval, 0.0))
+                node_count = node_count.at[lslots].set(
+                    jnp.where(do_split, left_stats[:, 2], 0.0))
+                node_count = node_count.at[rslots].set(
+                    jnp.where(do_split, right_stats[:, 2], 0.0))
+                if subtract:
+                    prev_split = do_split
+                    prev_ss = small_side
+                # --- route rows (shared with the general path below) --
+                nfeat = best_feat[local]
+                nbin = jnp.take_along_axis(binned, nfeat[:, None], 1)[:, 0]
+                nsplit = do_split[local]
+                go_left = left_mask[local, nbin]
+                child = jnp.where(go_left, 2 * node + 1, 2 * node + 2)
+                newly_done = ~nsplit & ~done
+                node = jnp.where(done | ~nsplit, node, child)
+                done = done | newly_done
+                continue
 
             # --- numerical split finding: ordered cumulative scan -------
             cum = jnp.cumsum(hist, axis=2)              # left stats per bin
@@ -1440,7 +1685,6 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
                 left_mask = mask_num
 
             # --- record splits & child stats -----------------------------
-            slots = level_start + jnp.arange(width)
             split_feature = split_feature.at[slots].set(
                 jnp.where(do_split, best_feat, -1))
             threshold_bin = threshold_bin.at[slots].set(
@@ -2123,6 +2367,39 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
             "only (LightGBM semantics); got objective="
             f"{cfg.objective!r}")
 
+    # ---- out-of-core dispatch: supported big fits stream from a spill
+    # directory instead of residing on device (models/gbdt/ooc.py) ------
+    ooc_mode = resolve_ooc(warn=True)
+    if ooc_mode == "off":
+        ooc_reason: Optional[str] = "MMLSPARK_TPU_OOC=off"
+    else:
+        ooc_reason = _ooc_supported(
+            cfg, mesh, k=k, has_valid=bool(valid_sets),
+            has_custom=custom_objective is not None,
+            has_groups=group_ids is not None, total_bins=total_bins)
+        want_ooc = (ooc_mode == "on"
+                    or n >= env_int("MMLSPARK_TPU_OOC_ROWS", 4_000_000,
+                                    minimum=1))
+        if want_ooc and ooc_reason is None:
+            from mmlspark_tpu.models.gbdt import ooc as ooc_mod
+            return ooc_mod.train_from_binned(
+                binned, labels, cfg, weights=weights, bin_upper=bin_upper,
+                init_model=init_model, init_raw=init_raw,
+                callbacks=callbacks, measures=measures,
+                iteration_offset=iteration_offset)
+        if want_ooc and ooc_reason is not None and ooc_mode == "on":
+            global _WARNED_OOC_DOWNGRADE
+            if not _WARNED_OOC_DOWNGRADE:
+                _WARNED_OOC_DOWNGRADE = True
+                import warnings
+                warnings.warn(
+                    f"MMLSPARK_TPU_OOC=on cannot stream this fit "
+                    f"({ooc_reason}); training in-core — label A/B "
+                    "measurements accordingly", stacklevel=2)
+        elif ooc_reason is None:
+            ooc_reason = (f"auto: {n} rows below the "
+                          "MMLSPARK_TPU_OOC_ROWS threshold")
+
     with measures.phase("dataPreparation"):
         if init_model is not None:
             # continued training (modelString warm start): keep the old
@@ -2249,7 +2526,8 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
             # fits, replicated/serial otherwise
             "grad_shard": ("dp" if (mesh is not None and not feature_mode)
                            else "off"),
-            "efb_bundles": 0, "efb_bundled_features": 0}
+            "efb_bundles": 0, "efb_bundled_features": 0,
+            "ooc": False, "ooc_reason": ooc_reason}
         if mesh is not None and shard_reason is not None:
             hist_stats["hist_shard_reason"] = shard_reason
         if mesh is not None and resolve_hist_quant(warn=False) != "off":
@@ -2381,6 +2659,20 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
                 pass  # a poisoned step must not mask the real error
         for tok in host_tokens:
             _release_host_binned(tok)
+    booster = _assemble_booster(trees, tree_weights, cfg, k, num_f,
+                                total_bins, depth, num_slots, bin_upper,
+                                base_score, best_iter, init_model)
+    return TrainResult(booster=booster, evals=evals,
+                       best_iteration=best_iter, hist_stats=hist_stats)
+
+
+def _assemble_booster(trees, tree_weights, cfg, k, num_f, total_bins, depth,
+                      num_slots, bin_upper, base_score, best_iter,
+                      init_model):
+    """Pack per-tree host arrays into a BoosterArrays (shared by the
+    in-core loops and the out-of-core trainer): rf weight normalization,
+    early-stop truncation, raw-value thresholds from bin_upper,
+    categorical bitsets, and warm-start concat."""
     trees_sf, trees_tb, trees_nv, trees_cnt, trees_dt, trees_bgl = trees
 
     num_trees = len(trees_sf)
@@ -2456,8 +2748,7 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
     )
     if init_model is not None:
         booster = BoosterArrays.concat(init_model, booster)
-    return TrainResult(booster=booster, evals=evals,
-                       best_iteration=best_iter, hist_stats=hist_stats)
+    return booster
 
 
 def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,

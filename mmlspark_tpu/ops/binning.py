@@ -21,9 +21,64 @@ Conventions (matching LightGBM semantics where visible to users):
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
+
+from mmlspark_tpu.ops.sketch import DEFAULT_SKETCH_K, QuantileSketch
+
+# Row-block size for BinMapper.transform: bounds the float64 staging copy
+# (and the int result staging) to block_rows x F instead of N x F.
+_TRANSFORM_BLOCK_ROWS = 65536
+
+
+def _feat_max_bin(fi: int, max_bin: int,
+                  max_bin_by_feature: Optional[Sequence[int]]) -> int:
+    if max_bin_by_feature is None or fi >= len(max_bin_by_feature):
+        return max_bin
+    o = int(max_bin_by_feature[fi])
+    # floor of 4 mirrors the maxBin validator: below that the
+    # missing + catch-all reservation leaves no usable bins
+    return min(max_bin, max(o, 4)) if o > 0 else max_bin
+
+
+def _numeric_edges(uniq: np.ndarray, counts: np.ndarray, usable_bins: int,
+                   min_data_in_bin: int) -> np.ndarray:
+    """Bin edges for one numeric feature from (distinct values, counts).
+
+    Shared by the exact path (``fit`` / small-cardinality streaming) and
+    the sketch path (``fit_streaming`` fallback, where ``counts`` are
+    sketch item weights).  For integer-valued counts this is bitwise
+    identical to the historical row-level computation: a weighted
+    bincount over distinct values equals the bincount over rows, and the
+    float accumulator comparisons are exact below 2**53.
+    """
+    if len(uniq) == 0:
+        return np.empty(0, dtype=np.float64)
+    if len(uniq) <= usable_bins:
+        # boundary = midpoint between adjacent distinct values
+        e = (uniq[:-1] + uniq[1:]) / 2.0
+    else:
+        # weighted quantiles over distinct values
+        cum = np.cumsum(counts)
+        total = cum[-1]
+        qs = (np.arange(1, usable_bins) / usable_bins) * total
+        idx = np.searchsorted(cum, qs)
+        idx = np.unique(np.minimum(idx, len(uniq) - 2))
+        e = (uniq[idx] + uniq[idx + 1]) / 2.0
+    if min_data_in_bin > 1 and len(e):
+        # drop edges that separate fewer than min_data_in_bin rows
+        bins = np.searchsorted(e, uniq, side="left")
+        counts_per = np.bincount(bins, weights=counts, minlength=len(e) + 1)
+        keep = []
+        acc = 0.0
+        for i in range(len(e)):
+            acc += counts_per[i]
+            if acc >= min_data_in_bin:
+                keep.append(i)
+                acc = 0.0
+        e = e[keep]
+    return np.asarray(e, dtype=np.float64)
 
 
 @dataclass
@@ -72,12 +127,7 @@ class BinMapper:
         cats: List[Optional[np.ndarray]] = []
 
         def feat_max_bin(fi):
-            if max_bin_by_feature is None or fi >= len(max_bin_by_feature):
-                return max_bin
-            o = int(max_bin_by_feature[fi])
-            # floor of 4 mirrors the maxBin validator: below that the
-            # missing + catch-all reservation leaves no usable bins
-            return min(max_bin, max(o, 4)) if o > 0 else max_bin
+            return _feat_max_bin(fi, max_bin, max_bin_by_feature)
 
         for f in range(num_f):
             col = sample[:, f]
@@ -97,40 +147,101 @@ class BinMapper:
                 continue
             uniq, counts = np.unique(col, return_counts=True)
             usable_bins = feat_max_bin(f) - 2  # reserve missing + catch-all
-            if len(uniq) <= usable_bins:
-                # boundary = midpoint between adjacent distinct values
-                e = (uniq[:-1] + uniq[1:]) / 2.0
-            else:
-                # weighted quantiles over distinct values
-                cum = np.cumsum(counts)
-                total = cum[-1]
-                qs = (np.arange(1, usable_bins) / usable_bins) * total
-                idx = np.searchsorted(cum, qs)
-                idx = np.unique(np.minimum(idx, len(uniq) - 2))
-                e = (uniq[idx] + uniq[idx + 1]) / 2.0
-            if min_data_in_bin > 1 and len(e):
-                # drop edges that separate fewer than min_data_in_bin rows
-                bins = np.searchsorted(e, col, side="left")
-                counts_per = np.bincount(bins, minlength=len(e) + 1)
-                keep = []
-                acc = 0
-                for i in range(len(e)):
-                    acc += counts_per[i]
-                    if acc >= min_data_in_bin:
-                        keep.append(i)
-                        acc = 0
-                e = e[keep]
-            edges.append(e.astype(np.float64))
+            edges.append(_numeric_edges(uniq, counts, usable_bins,
+                                        min_data_in_bin))
         return BinMapper(edges, cat, cats, max_bin)
+
+    @staticmethod
+    def fit_streaming(chunks: Iterable[np.ndarray], max_bin: int = 255,
+                      categorical_features: Sequence[int] = (),
+                      min_data_in_bin: int = 3,
+                      max_bin_by_feature: Optional[Sequence[int]] = None,
+                      sketch_k: int = DEFAULT_SKETCH_K) -> "BinMapper":
+        """One-pass streaming construction over row chunks.
+
+        Per feature, an exact distinct-value tally runs alongside a
+        mergeable :class:`QuantileSketch`; if a feature's cardinality
+        stays under the tally cap the edges come out **identical** to
+        ``fit`` over the concatenated chunks, otherwise the sketch's
+        (value, weight) items feed the same edge computation so the
+        result is parity-comparable within the sketch's rank-error
+        bound.  Peak memory is one chunk plus the per-feature sketches —
+        never the concatenated dataset.
+
+        Categorical features need exact global category counts and are
+        not supported here; bin them via ``fit`` on a row sample.
+        """
+        if len(list(categorical_features)) > 0:
+            raise ValueError(
+                "fit_streaming supports numeric features only; bin "
+                "categorical features via BinMapper.fit on a row sample")
+        sketches: Optional[List[QuantileSketch]] = None
+        tallies: List[Optional[Dict[float, int]]] = []
+        num_f = 0
+        for chunk in chunks:
+            c = np.asarray(chunk, dtype=np.float64)
+            if c.ndim != 2:
+                raise ValueError(f"chunks must be 2-d, got shape {c.shape}")
+            if sketches is None:
+                num_f = c.shape[1]
+                sketches = [QuantileSketch(sketch_k) for _ in range(num_f)]
+                tallies = [dict() for _ in range(num_f)]
+            elif c.shape[1] != num_f:
+                raise ValueError(
+                    f"chunk has {c.shape[1]} features, expected {num_f}")
+            for f in range(num_f):
+                col = c[:, f]
+                col = col[~np.isnan(col)]
+                sketches[f].update(col)
+                tally = tallies[f]
+                if tally is not None:
+                    uniq, counts = np.unique(col, return_counts=True)
+                    for v, cnt in zip(uniq.tolist(), counts.tolist()):
+                        tally[v] = tally.get(v, 0) + cnt
+                    usable = _feat_max_bin(f, max_bin, max_bin_by_feature) - 2
+                    if len(tally) > max(4096, 4 * usable):
+                        tallies[f] = None  # high cardinality: sketch only
+        if sketches is None:
+            raise ValueError("fit_streaming requires at least one chunk")
+        edges: List[np.ndarray] = []
+        cats: List[Optional[np.ndarray]] = [None] * num_f
+        for f in range(num_f):
+            usable = _feat_max_bin(f, max_bin, max_bin_by_feature) - 2
+            tally = tallies[f]
+            if tally is not None:
+                items = sorted(tally.items())
+                uniq = np.asarray([it[0] for it in items], dtype=np.float64)
+                counts = np.asarray([it[1] for it in items], dtype=np.int64)
+            else:
+                uniq, counts = sketches[f].items()
+            edges.append(_numeric_edges(uniq, counts, usable,
+                                        min_data_in_bin))
+        return BinMapper(edges, np.zeros(num_f, dtype=bool), cats, max_bin)
 
     # -- application --------------------------------------------------------
     def transform(self, x: np.ndarray) -> np.ndarray:
-        """Map raw features (N, F) to bin ids (N, F) int32; NaN -> bin 0."""
-        x = np.asarray(x, dtype=np.float64)
-        if not any(self.is_categorical):
-            native = self._transform_native(x)
-            if native is not None:
-                return native
+        """Map raw features (N, F) to bin ids (N, F) int32; NaN -> bin 0.
+
+        Rows are binned in bounded blocks so a non-float64 input never
+        materializes a full float64 copy — peak staging overhead is one
+        block (``_TRANSFORM_BLOCK_ROWS`` rows), which also caps the
+        in-core fit path's binning RSS.  Output is bitwise identical to
+        whole-array binning (rows are independent).
+        """
+        x = np.asarray(x)
+        out = np.zeros(x.shape, dtype=np.int32)
+        try_native = not any(self.is_categorical)
+        for s in range(0, x.shape[0], _TRANSFORM_BLOCK_ROWS):
+            block = np.asarray(x[s:s + _TRANSFORM_BLOCK_ROWS],
+                               dtype=np.float64)
+            binned = self._transform_native(block) if try_native else None
+            if binned is None:
+                try_native = False
+                binned = self._transform_python(block)
+            out[s:s + _TRANSFORM_BLOCK_ROWS] = binned
+        return out
+
+    def _transform_python(self, x: np.ndarray) -> np.ndarray:
         out = np.zeros(x.shape, dtype=np.int32)
         for f in range(self.num_features):
             col = x[:, f]

@@ -13,7 +13,9 @@ narrow dtype free on device (gathers/adds fuse the widening).
 
 from __future__ import annotations
 
-from typing import Any, Optional
+import json
+import os
+from typing import Any, Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -81,3 +83,119 @@ def binned_ingest_dtype(total_bins: int):
     if total_bins <= 65536:
         return np.uint16
     return np.int32
+
+
+# -- spill-directory chunk store (out-of-core training plane) ---------------
+#
+# The out-of-core GBDT fit streams pre-binned row chunks from disk instead
+# of holding the (N, F) binned matrix resident. The format is deliberately
+# dumb: one .npy per chunk plus a JSON manifest, written append-only and
+# sealed by an atomic manifest rename, so a partially written spill is
+# never mistaken for a complete one.
+
+_SPILL_MANIFEST = "spill_meta.json"
+
+
+class SpillWriter:
+    """Append-only writer for a binned row-chunk spill directory.
+
+    ``append`` writes each chunk as ``chunk_{i:06d}.npy`` (narrowed to
+    ``dtype``); ``finalize`` atomically publishes the manifest and
+    returns a :class:`SpillReader`. Chunks may have uneven row counts;
+    the feature count and dtype must stay fixed.
+    """
+
+    def __init__(self, path: str, dtype: Any = np.uint8) -> None:
+        self.path = path
+        self.dtype = np.dtype(dtype)
+        self.chunk_rows: List[int] = []
+        self.n_features: Optional[int] = None
+        self._sealed = False
+        os.makedirs(path, exist_ok=True)
+
+    def append(self, chunk: np.ndarray) -> None:
+        if self._sealed:
+            raise RuntimeError("SpillWriter already finalized")
+        c = np.ascontiguousarray(chunk)
+        if c.ndim != 2:
+            raise ValueError(f"spill chunks must be 2-d, got {c.shape}")
+        if self.n_features is None:
+            self.n_features = int(c.shape[1])
+        elif c.shape[1] != self.n_features:
+            raise ValueError(
+                f"chunk has {c.shape[1]} features, expected {self.n_features}")
+        i = len(self.chunk_rows)
+        np.save(os.path.join(self.path, f"chunk_{i:06d}.npy"),
+                c.astype(self.dtype, copy=False))
+        self.chunk_rows.append(int(c.shape[0]))
+
+    def finalize(self) -> "SpillReader":
+        from mmlspark_tpu.core.serialize import atomic_write
+
+        if self.n_features is None:
+            raise ValueError("spill has no chunks")
+        meta = {
+            "version": 1,
+            "dtype": self.dtype.name,
+            "n_features": self.n_features,
+            "chunk_rows": self.chunk_rows,
+            "total_rows": int(sum(self.chunk_rows)),
+        }
+        atomic_write(os.path.join(self.path, _SPILL_MANIFEST),
+                     json.dumps(meta, indent=1))
+        self._sealed = True
+        return SpillReader(self.path)
+
+
+class SpillReader:
+    """Reader over a sealed spill directory (see :class:`SpillWriter`)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        with open(os.path.join(path, _SPILL_MANIFEST)) as fh:
+            meta = json.load(fh)
+        self.dtype = np.dtype(meta["dtype"])
+        self.n_features = int(meta["n_features"])
+        self.chunk_rows: List[int] = [int(r) for r in meta["chunk_rows"]]
+        self.total_rows = int(meta["total_rows"])
+        self.offsets: List[int] = []
+        off = 0
+        for r in self.chunk_rows:
+            self.offsets.append(off)
+            off += r
+
+    @property
+    def num_chunks(self) -> int:
+        return len(self.chunk_rows)
+
+    def read(self, i: int) -> np.ndarray:
+        return np.load(os.path.join(self.path, f"chunk_{i:06d}.npy"))
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        for i in range(self.num_chunks):
+            yield self.read(i)
+
+
+class ChunkStore:
+    """Per-chunk float array store for out-of-core per-row state (raw
+    score carry, quantized grad/hess). Same chunking as the companion
+    spill; overwritten in place each iteration via tmp + ``os.replace``
+    so a torn write never corrupts a chunk (resume rebuilds this state
+    from checkpoints anyway — the atomicity just keeps same-process
+    retries honest)."""
+
+    def __init__(self, path: str, name: str) -> None:
+        self.path = path
+        self.name = name
+        os.makedirs(path, exist_ok=True)
+
+    def _file(self, i: int) -> str:
+        return os.path.join(self.path, f"{self.name}_{i:06d}.npy")
+
+    def put(self, i: int, arr: np.ndarray) -> None:
+        tmp = self._file(i) + ".tmp.npy"
+        np.save(tmp, np.ascontiguousarray(arr))
+        os.replace(tmp, self._file(i))
+
+    def get(self, i: int) -> np.ndarray:
+        return np.load(self._file(i))

@@ -1,0 +1,327 @@
+"""Out-of-core chunked boosting (models/gbdt/ooc.py).
+
+The contract under test: with shared (sketch-derived) bin edges, the
+streamed fit produces IDENTICAL trees to the in-core path — bitwise, not
+approximately — while holding only chunk-sized state resident. Plus the
+dispatch policy (MMLSPARK_TPU_OOC=auto|off|on), downgrade semantics,
+chunk-store label streaming, and resume through segment checkpoints.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.faults import FaultInjected
+from mmlspark_tpu.models.gbdt import ooc
+from mmlspark_tpu.models.gbdt import trainer as T
+from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+from mmlspark_tpu.ops.binning import BinMapper
+from mmlspark_tpu.ops.ingest import ChunkStore, SpillWriter, binned_ingest_dtype
+
+_BOOSTER_ARRAYS = ("split_feature", "threshold_bin", "node_value", "count")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def parity_env(monkeypatch):
+    """Pin the planes where OOC and in-core are defined to coincide:
+    quantized histograms (f32 sums are not chunk-associative) and no
+    EFB (bundling decisions see full columns in-core only)."""
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_QUANT", "q16")
+    monkeypatch.setenv("MMLSPARK_TPU_EFB", "off")
+    monkeypatch.setenv("MMLSPARK_TPU_OOC_CHUNK_ROWS", "1024")
+
+
+def _make_data(rng, n=4000, f=8):
+    x = rng.normal(size=(n, f))
+    x[:, 3] = rng.integers(0, 5, size=n)  # low-cardinality column
+    y = (x[:, 0] * 2 + np.sin(x[:, 1])
+         + 0.1 * rng.normal(size=n)).astype(np.float64)
+    return x, y
+
+
+@pytest.mark.ooc_smoke
+def test_ooc_parity_bitwise_with_in_core(rng, parity_env, monkeypatch):
+    """The tentpole acceptance: streamed fit == in-core fit
+    tree-for-tree on a size both can hold, with bin edges from the
+    streaming sketch path feeding both."""
+    x, y = _make_data(rng)
+    bm = BinMapper.fit_streaming(iter([x[:1777], x[1777:3200], x[3200:]]),
+                                 max_bin=63)
+    binned = bm.transform(x)
+    cfg = T.TrainConfig(objective="regression", num_iterations=6,
+                        max_depth=4, num_leaves=14, learning_rate=0.2,
+                        max_bin=63)
+
+    monkeypatch.setenv("MMLSPARK_TPU_OOC", "off")
+    r_in = T.train(binned, y, cfg)
+    assert r_in.hist_stats["ooc"] is False
+    assert r_in.hist_stats["ooc_reason"] == "MMLSPARK_TPU_OOC=off"
+
+    monkeypatch.setenv("MMLSPARK_TPU_OOC", "on")
+    r_ooc = T.train(binned, y, cfg)
+    st = r_ooc.hist_stats
+    assert st["ooc"] is True and st["ooc_reason"] is None
+    assert st["chunk_rows"] == 1024 and st["n_chunks"] == 4
+    assert st["hist_quant"] == "q16"
+
+    for name in _BOOSTER_ARRAYS:
+        np.testing.assert_array_equal(
+            getattr(r_in.booster, name), getattr(r_ooc.booster, name),
+            err_msg=f"booster.{name} diverged between in-core and ooc")
+
+
+@pytest.mark.ooc_smoke
+def test_ooc_kill_and_resume_mid_ensemble(rng, parity_env, monkeypatch,
+                                          tmp_path):
+    """A streamed fit killed mid-ensemble resumes through the PR 2
+    segment checkpoints and reproduces the uninterrupted streamed run
+    bitwise (the OOC dispatch re-engages per resumed segment)."""
+    monkeypatch.setenv("MMLSPARK_TPU_OOC", "on")
+    x, y = _make_data(rng, n=2500, f=4)
+    df = DataFrame({"features": x, "label": y})
+    kw = dict(numIterations=9, numLeaves=8, maxBin=32,
+              checkpointInterval=3)
+
+    ref = LightGBMRegressor(checkpointDir=str(tmp_path / "a"), **kw).fit(df)
+
+    # hit 7 = first iteration of the third segment: checkpoints at 3
+    # and 6 are committed, iteration 7's work dies with the "process"
+    ckb = str(tmp_path / "b")
+    with faults.injected("gbdt.train_step", "raise", nth=7):
+        with pytest.raises(FaultInjected):
+            LightGBMRegressor(checkpointDir=ckb, **kw).fit(df)
+    names = sorted(n for n in os.listdir(ckb) if n.endswith(".txt"))
+    assert names == ["checkpoint_3.txt", "checkpoint_6.txt"]
+
+    resumed = LightGBMRegressor(checkpointDir=ckb, **kw).fit(df)
+    assert resumed.booster.num_trees == 9
+    np.testing.assert_array_equal(
+        np.asarray(ref.transform(df)["prediction"]),
+        np.asarray(resumed.transform(df)["prediction"]))
+
+
+def test_ooc_auto_threshold_and_reason(rng, parity_env, monkeypatch):
+    x, y = _make_data(rng, n=2000, f=4)
+    binned = BinMapper.fit(x, max_bin=32).transform(x)
+    cfg = T.TrainConfig(objective="regression", num_iterations=2,
+                        max_depth=3, max_bin=32)
+
+    monkeypatch.delenv("MMLSPARK_TPU_OOC", raising=False)
+    small = T.train(binned, y, cfg)
+    assert small.hist_stats["ooc"] is False
+    assert "below" in small.hist_stats["ooc_reason"]
+
+    # auto engages once the row count crosses MMLSPARK_TPU_OOC_ROWS
+    monkeypatch.setenv("MMLSPARK_TPU_OOC_ROWS", "1000")
+    big = T.train(binned, y, cfg)
+    assert big.hist_stats["ooc"] is True
+    assert big.hist_stats["n_chunks"] == 2
+
+
+def test_ooc_on_downgrades_unsupported_with_one_warning(
+        rng, parity_env, monkeypatch):
+    monkeypatch.setenv("MMLSPARK_TPU_OOC", "on")
+    monkeypatch.setattr(T, "_WARNED_OOC_DOWNGRADE", False)
+    x, y = _make_data(rng, n=1500, f=4)
+    binned = BinMapper.fit(x, max_bin=32).transform(x)
+    cfg = T.TrainConfig(objective="regression", num_iterations=2,
+                        max_depth=3, max_bin=32, feature_fraction=0.5)
+    with pytest.warns(UserWarning, match="cannot stream"):
+        r = T.train(binned, y, cfg)
+    assert r.hist_stats["ooc"] is False
+    assert r.hist_stats["ooc_reason"] == "feature sampling"
+    # warn-once: the second downgraded fit stays quiet
+    import warnings
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        T.train(binned, y, cfg)
+    assert not [w for w in rec if "cannot stream" in str(w.message)]
+
+
+def test_train_ooc_chunk_store_labels_match_array_labels(
+        rng, parity_env, tmp_path):
+    """A truly larger-than-memory fit passes labels per chunk; the
+    streamed weighted-mean base score and every downstream tree must
+    be bitwise identical to the array-label path over the same spill."""
+    x, y = _make_data(rng, n=3000, f=5)
+    bm = BinMapper.fit_streaming(iter([x[:1300], x[1300:]]), max_bin=32)
+    cfg = T.TrainConfig(objective="regression", num_iterations=3,
+                        max_depth=3, max_bin=32)
+    writer = SpillWriter(str(tmp_path / "spill"),
+                         dtype=binned_ingest_dtype(cfg.max_bin))
+    labels = ChunkStore(str(tmp_path / "labels"), "y")
+    for i, (s, e) in enumerate(((0, 1100), (1100, 2150), (2150, 3000))):
+        writer.append(bm.transform(x[s:e]))
+        labels.put(i, y[s:e].astype(np.float32))
+    spill = writer.finalize()
+
+    r_store = ooc.train_ooc(spill, labels, cfg,
+                            work_dir=str(tmp_path / "w1"))
+    r_array = ooc.train_ooc(spill, y, cfg, work_dir=str(tmp_path / "w2"))
+    assert r_store.hist_stats["ooc"] is True
+    assert r_store.hist_stats["n_chunks"] == 3
+    for name in _BOOSTER_ARRAYS:
+        np.testing.assert_array_equal(getattr(r_store.booster, name),
+                                      getattr(r_array.booster, name))
+
+
+def test_train_ooc_rejects_unsupported_and_median_objectives(
+        rng, parity_env, tmp_path):
+    x, y = _make_data(rng, n=1200, f=4)
+    bm = BinMapper.fit(x, max_bin=32)
+    writer = SpillWriter(str(tmp_path / "spill"), dtype=np.uint8)
+    labels = ChunkStore(str(tmp_path / "labels"), "y")
+    writer.append(bm.transform(x[:700]))
+    writer.append(bm.transform(x[700:]))
+    labels.put(0, y[:700].astype(np.float32))
+    labels.put(1, y[700:].astype(np.float32))
+    spill = writer.finalize()
+
+    bad = T.TrainConfig(objective="regression", num_iterations=2,
+                        max_bin=32, feature_fraction=0.5)
+    with pytest.raises(ValueError, match="cannot stream"):
+        ooc.train_ooc(spill, y, bad, work_dir=str(tmp_path / "w"))
+
+    # median-based init needs full labels: chunk stores must refuse
+    # loudly rather than silently approximating
+    l1 = T.TrainConfig(objective="regression_l1", num_iterations=2,
+                       max_bin=32)
+    with pytest.raises(ValueError, match="median"):
+        ooc.train_ooc(spill, labels, l1, work_dir=str(tmp_path / "w2"))
+    # ...but full array labels stream fine under the same objective
+    r = ooc.train_ooc(spill, y, l1, work_dir=str(tmp_path / "w3"))
+    assert r.booster.num_trees == 2
+
+
+_RSS_SCRIPT = r"""
+import resource
+import sys
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from mmlspark_tpu.models.gbdt import ooc
+from mmlspark_tpu.models.gbdt import trainer as T
+from mmlspark_tpu.ops.binning import BinMapper
+from mmlspark_tpu.ops.ingest import ChunkStore, SpillWriter
+
+mode, spill_dir = sys.argv[1], sys.argv[2]
+N, F, CHUNK = 4_000_000, 8, 262_144
+
+
+def gen(i, rows):
+    r = np.random.default_rng(1000 + i)
+    return r.normal(size=(rows, F))
+
+
+def chunks():
+    for i, s in enumerate(range(0, N, CHUNK)):
+        yield i, s, gen(i, min(CHUNK, N - s))
+
+
+bm = BinMapper.fit_streaming((c for _, _, c in chunks()), max_bin=32)
+cfg = T.TrainConfig(objective="regression", num_iterations=4,
+                    max_depth=3, max_bin=32)
+
+if mode == "ooc":
+    writer = SpillWriter(spill_dir + "/binned", dtype=np.uint8)
+    labels = ChunkStore(spill_dir + "/labels", "y")
+    for i, s, c in chunks():
+        writer.append(bm.transform(c))
+        labels.put(i, (c[:, 0] * 2.0).astype(np.float32))
+    spill = writer.finalize()
+    base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    marks = []
+    cb = lambda t, info: marks.append(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+    r = ooc.train_ooc(spill, labels, cfg, work_dir=spill_dir + "/w",
+                      callbacks=[cb])
+    assert r.hist_stats["ooc"] is True
+    # growth after the first TWO full passes (jit compiles land across
+    # the first iterations, allocator arenas warm, every per-row store
+    # populated): the steady state
+    peak0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    print("STEADY_KB", peak0 - marks[1], flush=True)
+else:
+    binned = np.empty((N, F), dtype=np.uint8)
+    y = np.empty(N, dtype=np.float32)
+    for i, s, c in chunks():
+        binned[s:s + len(c)] = bm.transform(c)
+        y[s:s + len(c)] = (c[:, 0] * 2.0).astype(np.float32)
+    base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    r = T.train(binned, y, cfg)
+
+peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+print("DELTA_KB", peak - base, flush=True)
+"""
+
+
+def _fit_rss_delta_mb(mode, tmp_path):
+    env = dict(os.environ,
+               MMLSPARK_TPU_HIST_QUANT="q16", MMLSPARK_TPU_EFB="off",
+               MMLSPARK_TPU_OOC="off" if mode == "incore" else "on",
+               MMLSPARK_TPU_OOC_CHUNK_ROWS="262144",
+               PYTHONPATH=os.getcwd() + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    d = tmp_path / mode
+    d.mkdir()
+    out = subprocess.run(
+        [sys.executable, "-c", _RSS_SCRIPT, mode, str(d)],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    vals = {}
+    for l in out.stdout.splitlines():
+        if l.startswith(("DELTA_KB", "STEADY_KB")):
+            key, v = l.split()
+            vals[key] = int(v) / 1024.0
+    return vals
+
+
+def test_ooc_fit_rss_stays_chunk_bounded(tmp_path):
+    """Peak RSS growth during the streamed fit must track the chunk
+    working set, not the row count: on the same 4M-row fit the in-core
+    path materializes full-N device state (binned + grad/hess/raw,
+    ~100MB+) while the OOC loop holds chunk-sized buffers. The total
+    OOC delta includes one-time jit-compile/allocator-arena overhead
+    (tens of MB, run-to-run noisy), so the sharp bound is on the
+    STEADY-state growth after the first two full passes — per-row state
+    all lives on disk by then, so further growth can only be
+    chunk-scale."""
+    got = _fit_rss_delta_mb("ooc", tmp_path)
+    # absolute caps first — pressure-robust (memory pressure can only
+    # shrink an RSS delta, never inflate it): the streamed fit stays
+    # well under the ~300MB full-N in-core working set even counting
+    # the one-time warmup overhead...
+    assert got["DELTA_KB"] < 224, (
+        f"ooc fit grew {got['DELTA_KB']:.0f}MB — full-N scale, "
+        "not chunk-bounded")
+    # ...and once warm, boosting adds only chunk-scale memory
+    # (chunk working set here is ~10MB; in-core-style growth would be
+    # full-N scale, 100MB+)
+    assert got["STEADY_KB"] < 48, (
+        f"steady-state ooc growth {got['STEADY_KB']:.0f}MB is not "
+        "chunk-bounded")
+    # The relative leg needs a quiet box: under global memory pressure
+    # (e.g. the full suite running in the parent) the kernel evicts
+    # pages mid-fit and ru_maxrss never rises above the pre-train
+    # baseline — the in-core probe reads ~0MB. Compare only when the
+    # probe actually saw the full-N working set; the absolute caps
+    # above carry the bound either way.
+    incore = _fit_rss_delta_mb("incore", tmp_path)["DELTA_KB"]
+    if incore > 60:
+        assert got["DELTA_KB"] < incore, (
+            f"ooc fit grew {got['DELTA_KB']:.0f}MB vs "
+            f"in-core {incore:.0f}MB")

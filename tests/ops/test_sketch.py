@@ -1,0 +1,182 @@
+"""Streaming quantile sketch (ops/sketch.py): deterministic KLL-style
+compactors with an analytic rank-error bound, merge associativity, and
+degenerate-feature exactness — the substrate under
+``BinMapper.fit_streaming``."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.ops.sketch import DEFAULT_SKETCH_K, QuantileSketch
+
+
+def _true_rank(values: np.ndarray, q: float) -> np.ndarray:
+    return np.sum(np.sort(values) <= q)
+
+
+# -- adversarial distributions: the sketch's rank error must stay within
+# its own analytic bound (sum of 2**level over compactions), not a
+# distributional estimate
+_DISTRIBUTIONS = {
+    "uniform": lambda r, n: r.uniform(0, 1, n),
+    "sorted": lambda r, n: np.sort(r.uniform(0, 1, n)),
+    "reverse_sorted": lambda r, n: np.sort(r.uniform(0, 1, n))[::-1],
+    "heavy_dupes": lambda r, n: r.integers(0, 17, n).astype(np.float64),
+    "lognormal_tail": lambda r, n: r.lognormal(0.0, 4.0, n),
+    "alternating_extremes": lambda r, n: np.where(
+        np.arange(n) % 2 == 0, 1e300, -1e300) + r.uniform(0, 1, n),
+}
+
+
+@pytest.mark.parametrize("dist", sorted(_DISTRIBUTIONS))
+def test_rank_error_within_analytic_bound(dist, rng):
+    n = 200_000
+    values = _DISTRIBUTIONS[dist](rng, n)
+    sk = QuantileSketch(k=256)  # small k forces many compactions
+    for s in range(0, n, 10_000):
+        sk.update(values[s:s + 10_000])
+    assert sk.n == n
+    bound = sk.rank_error()
+    assert bound > 0  # this shape must actually compact
+    svals = np.sort(values)
+    for q in (0.0, 0.01, 0.25, 0.5, 0.75, 0.99, 1.0):
+        v = sk.quantile(q)
+        est_rank = q * n
+        true_rank = np.searchsorted(svals, v, side="right")
+        lo = np.searchsorted(svals, v, side="left")
+        # |true_rank - q*n| <= err (rank estimate of v)
+        #                    + err + 1 (v's retained weight; any level-L
+        #                      item implies err >= 2**L - 1)
+        #                    + err (weight-total drift from n)
+        # plus the true multiplicity of v itself for duplicate-heavy data
+        slack = 3 * bound + (true_rank - lo) + 2
+        assert abs(true_rank - est_rank) <= slack, (
+            f"{dist} q={q}: rank {true_rank} vs target {est_rank} "
+            f"exceeds bound {slack}")
+
+
+def test_merge_matches_single_stream_bound_and_extremes(rng):
+    a = rng.normal(size=37_123)
+    b_ = rng.lognormal(1.0, 2.0, size=8_001)
+    c = np.full(5_000, 3.25)
+    merged = QuantileSketch(k=128)
+    for part in (a, b_, c):
+        piece = QuantileSketch(k=128)
+        piece.update(part)
+        merged.merge(piece)
+    full = np.concatenate([a, b_, c])
+    assert merged.n == full.size
+    assert merged.vmin == full.min()
+    assert merged.vmax == full.max()
+    # error bounds add across merges; ranks stay within the bound
+    bound = merged.rank_error()
+    svals = np.sort(full)
+    for q in (0.1, 0.5, 0.9):
+        v = merged.quantile(q)
+        lo = np.searchsorted(svals, v, side="left")
+        hi = np.searchsorted(svals, v, side="right")
+        assert abs(hi - q * full.size) <= 3 * bound + (hi - lo) + 2
+
+
+def test_merge_associativity_of_guarantees(rng):
+    """(a + b) + c vs a + (b + c): retained items may differ (the parity
+    schedule interleaves compactions differently), but the guarantees
+    are associative — exact n/min/max either way, and every quantile of
+    either result stays within that result's own analytic bound of the
+    true rank. This is the property that makes chunk-parallel binning
+    safe."""
+    parts = [rng.uniform(-5, 5, size=9_777) for _ in range(3)]
+    full = np.concatenate(parts)
+    svals = np.sort(full)
+
+    def fresh(i):
+        s = QuantileSketch(k=64)
+        s.update(parts[i])
+        return s
+
+    left = fresh(0).merge(fresh(1)).merge(fresh(2))
+    right = fresh(0).merge(fresh(1).merge(fresh(2)))
+    for s in (left, right):
+        assert s.n == full.size
+        assert s.vmin == full.min()
+        assert s.vmax == full.max()
+        bound = s.rank_error()
+        assert 0 < bound < 0.2 * full.size
+        for q in (0.05, 0.5, 0.95):
+            v = s.quantile(q)
+            hi = np.searchsorted(svals, v, side="right")
+            lo = np.searchsorted(svals, v, side="left")
+            assert abs(hi - q * full.size) <= 3 * bound + (hi - lo) + 2
+
+
+def test_determinism_across_runs(rng):
+    values = rng.normal(size=50_000)
+    runs = []
+    for _ in range(2):
+        s = QuantileSketch(k=128)
+        for chunk in np.array_split(values, 7):
+            s.update(chunk)
+        runs.append(s)
+    v0, w0 = runs[0].items()
+    v1, w1 = runs[1].items()
+    np.testing.assert_array_equal(v0, v1)
+    np.testing.assert_array_equal(w0, w1)
+
+
+def test_empty_constant_and_nan_features():
+    s = QuantileSketch()
+    assert len(s) == 0
+    assert s.rank_error() == 0
+    assert np.isnan(s.quantile(0.5))
+
+    # all-NaN stream stays empty (NaNs filtered on ingest)
+    s.update(np.full(1000, np.nan))
+    assert len(s) == 0
+
+    # constant feature: every quantile is the constant, exactly
+    s.update(np.full(10_000, 7.5))
+    assert len(s) == 10_000
+    assert s.vmin == s.vmax == 7.5
+    for q in (0.0, 0.3, 1.0):
+        assert s.quantile(q) == 7.5
+
+    # mixed NaN/value stream counts only the values
+    s2 = QuantileSketch()
+    v = np.arange(100, dtype=np.float64)
+    v[::3] = np.nan
+    s2.update(v)
+    assert len(s2) == int(np.sum(~np.isnan(v)))
+    assert s2.vmin == 1.0 and s2.vmax == 98.0
+
+
+def test_small_n_is_exact(rng):
+    """Below capacity nothing compacts: the sketch is the exact
+    multiset, rank_error stays 0, quantiles are exact order stats."""
+    values = rng.uniform(0, 1, size=500)
+    s = QuantileSketch(k=2048)
+    s.update(values)
+    assert s.rank_error() == 0
+    vals, wts = s.items()
+    np.testing.assert_array_equal(vals, np.unique(values))
+    assert wts.sum() == values.size
+    sv = np.sort(values)
+    assert s.quantile(0.5) in sv
+
+
+def test_k_validation_and_mismatched_merge():
+    with pytest.raises(ValueError):
+        QuantileSketch(k=4)
+    a, b_ = QuantileSketch(k=64), QuantileSketch(k=128)
+    b_.update(np.ones(10))
+    with pytest.raises(ValueError):
+        a.merge(b_)
+
+
+def test_default_k_error_small_relative(rng):
+    """At the default capacity the realized rank error on 1M rows stays
+    well under 1% relative — the guarantee bin edges lean on."""
+    n = 1_000_000
+    s = QuantileSketch(k=DEFAULT_SKETCH_K)
+    vals = rng.normal(size=n)
+    for c in np.array_split(vals, 16):
+        s.update(c)
+    assert s.rank_error() < 0.01 * n
