@@ -428,7 +428,9 @@ def ooc_main():
         "peak_rss_mb": peak_rss_mb(),
         **{k: result.hist_stats.get(k)
            for k in ("ooc", "ooc_reason", "chunk_rows", "n_chunks",
-                     "hist_quant", "hist_subtract")},
+                     "hist_quant", "hist_subtract", "spill_verify",
+                     "spill_verify_s", "spill_verify_chunks",
+                     "spill_repairs")},
     }))
 
 

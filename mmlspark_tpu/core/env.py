@@ -272,6 +272,19 @@ OOC_CHUNK_ROWS = register(
     "MMLSPARK_TPU_OOC_CHUNK_ROWS", "int", 262_144,
     "out-of-core training: rows per spill chunk; peak training RSS "
     "scales with this (chunk working set), not with the dataset")
+SPILL_VERIFY = register(
+    "MMLSPARK_TPU_SPILL_VERIFY", "str", "auto",
+    "integrity verification for on-disk artifacts: auto|off|on — auto "
+    "(default) always verifies checkpoint payload digests and checks "
+    "each spill/chunk-store chunk's crc32 on its first read (and "
+    "after every rewrite), on verifies every read, off trusts the "
+    "disk; verification cost is stamped in hist_stats")
+CHAOSFUZZ_BUDGET_S = register(
+    "MMLSPARK_TPU_CHAOSFUZZ_BUDGET_S", "float", 30.0,
+    "tools/chaosfuzz: per-schedule wall-clock watchdog budget in "
+    "seconds (the stall_guard backstop) — a scenario still running "
+    "past it is recorded as a hang violation, never an indefinite "
+    "hang; --budget overrides")
 
 
 _WARNED: Set[str] = set()

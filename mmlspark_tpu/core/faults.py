@@ -41,7 +41,8 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
 __all__ = ["FaultInjected", "KNOWN_POINTS", "fault_point", "arm",
-           "disarm", "reset", "hits", "injected", "arm_from_env"]
+           "disarm", "reset", "hits", "fired", "injected",
+           "arm_from_env"]
 
 
 class FaultInjected(RuntimeError):
@@ -103,6 +104,19 @@ KNOWN_POINTS: Dict[str, str] = {
                               "fit_resilient must re-form the mesh on "
                               "the surviving dp slice and resume from "
                               "the last segment checkpoint bitwise",
+    "io.disk_full": "guarded persistence writes (spill chunks, "
+                    "chunk-store state, checkpoint payloads and "
+                    "manifests) — an ENOSPC/quota failure; writers "
+                    "raise the attributed DiskFull and callers "
+                    "degrade (OOC falls back in-core when the rows "
+                    "permit, checkpoint writes skip with a warn-once) "
+                    "instead of crashing the fit",
+    "spill.read": "spill-plane chunk read (SpillReader / ChunkStore), "
+                  "applied to the payload bytes before checksum "
+                  "verification — an armed corrupt simulates disk "
+                  "bit-rot, which the crc32 check must catch and "
+                  "either repair from the source chunk iterator or "
+                  "raise an attributed SpillCorrupt",
 }
 
 _VALID_ACTIONS = ("raise", "delay", "corrupt")
@@ -213,6 +227,15 @@ def hits(name: str) -> int:
     (counting is part of the slow path: 0 when nothing was ever armed)."""
     with _lock:
         return _hit_counts.get(name, 0)
+
+
+def fired(name: str) -> int:
+    """How many times the fault currently armed on ``name`` actually
+    triggered (0 when disarmed) — the chaos-fuzz campaign's per-point
+    coverage signal."""
+    with _lock:
+        spec = _armed.get(name)
+        return 0 if spec is None else spec.fired
 
 
 @contextmanager

@@ -60,7 +60,8 @@ from mmlspark_tpu.core.env import (REFRESH_INTERVAL_S, STREAM_BUFFER,
                                    env_int)
 from mmlspark_tpu.core.faults import fault_point
 from mmlspark_tpu.core.logging_utils import logger
-from mmlspark_tpu.core.serialize import (load_latest_checkpoint,
+from mmlspark_tpu.core.serialize import (dir_digest,
+                                         load_latest_checkpoint,
                                          load_stage, save_checkpoint,
                                          save_stage)
 from mmlspark_tpu.exploratory.drift import DriftDetector, DriftReport
@@ -231,7 +232,8 @@ class RefreshController:
         # over the caller's model (the caller typically passes the
         # generation-0 fit, which a restart must not re-serve)
         latest = load_latest_checkpoint(checkpoint_dir,
-                                        self._config_hash())
+                                        self._config_hash(),
+                                        validate=self._validate_generation)
         if latest is not None:
             tag, state = latest
             self.generation = int(tag)
@@ -239,6 +241,28 @@ class RefreshController:
                 os.path.join(checkpoint_dir, state["model_dir"]))
             logger.info("refresh: resumed generation %d from %s",
                         self.generation, checkpoint_dir)
+
+    def _validate_generation(self, tag: int, state: dict):
+        """load_latest_checkpoint hook: re-digest the generation's
+        model directory against the digest its manifest committed.
+        A mismatch (bit-rot in a staged model file — the npz crc only
+        covers the manifest payload) makes the loader skip this
+        generation and fall back to the previous committed one, so a
+        restart never serves — or crashes on — rotten bytes.
+        Pre-digest generations pass unverified."""
+        digest = state.get("model_digest")
+        if digest is None:
+            return None
+        from mmlspark_tpu.ops.ingest import resolve_spill_verify
+        if resolve_spill_verify() == "off":
+            return None
+        model_dir = os.path.join(self.checkpoint_dir, state["model_dir"])
+        actual = dir_digest(model_dir)
+        if actual != digest:
+            return (f"generation {tag} model payload in {model_dir} "
+                    f"fails its digest (manifest {digest}, on disk "
+                    f"{actual}) — silent bit-rot")
+        return None
 
     def _config_hash(self) -> str:
         """Stable digest of the refit configuration: a restarted
@@ -360,7 +384,9 @@ class RefreshController:
                    os.path.join(self.checkpoint_dir, model_dir))
         save_checkpoint(self.checkpoint_dir, gen,
                         {"model_dir": model_dir, "rows": int(len(x)),
-                         "trigger": trigger},
+                         "trigger": trigger,
+                         "model_digest": dir_digest(os.path.join(
+                             self.checkpoint_dir, model_dir))},
                         self._config_hash())
         self.model = new_model
         self.generation = gen

@@ -616,9 +616,19 @@ class _LightGBMBase(Estimator, _LightGBMParams):
                 from mmlspark_tpu.core.logging_utils import warn_once
                 from mmlspark_tpu.core.serialize import atomic_write
                 try:
+                    import zlib
+                    model_str = result.booster.save_model_string()
                     atomic_write(
                         os.path.join(ckpt_dir, f"checkpoint_{done}.txt"),
-                        result.booster.save_model_string())
+                        model_str)
+                    # digest sidecar AFTER the payload: a crash in
+                    # between leaves a checkpoint without a digest,
+                    # which resume accepts unverified (legacy shape)
+                    # rather than discarding real progress
+                    atomic_write(
+                        os.path.join(ckpt_dir,
+                                     f"checkpoint_{done}.txt.crc32"),
+                        f"{zlib.crc32(model_str.encode()) & 0xFFFFFFFF:08x}")
                 except OSError as e:
                     # graceful degradation: a failing checkpoint store
                     # (full disk, flaky blob mount) must not kill a
@@ -681,16 +691,55 @@ class _LightGBMBase(Estimator, _LightGBMParams):
 
     @staticmethod
     def _latest_checkpoint(ckpt_dir):
+        """Newest segment checkpoint whose crc32 sidecar verifies.
+
+        A checkpoint failing its digest (silent bit-rot) is skipped
+        with an attributed warn-once and the scan falls back one
+        generation — a resumed ``fit``/``fit_resilient`` loses restart
+        depth, never crashes on rotten bytes. Sidecar-less checkpoints
+        (pre-integrity runs, or a crash between payload and sidecar)
+        are accepted unverified; MMLSPARK_TPU_SPILL_VERIFY=off skips
+        the check entirely."""
         import os
         import re
-        best = None
+        import zlib
+
+        from mmlspark_tpu.core.logging_utils import warn_once
+        from mmlspark_tpu.ops.ingest import resolve_spill_verify
+        cands = []
         if os.path.isdir(ckpt_dir):
             for name in os.listdir(ckpt_dir):
                 m = re.fullmatch(r"checkpoint_(\d+)\.txt", name)
-                if m and (best is None or int(m.group(1)) > best[0]):
-                    best = (int(m.group(1)),
-                            os.path.join(ckpt_dir, name))
-        return best
+                if m:
+                    cands.append((int(m.group(1)),
+                                  os.path.join(ckpt_dir, name)))
+        verify = resolve_spill_verify() != "off"
+        for done, path in sorted(cands, reverse=True):
+            if not verify:
+                return (done, path)
+            try:
+                with open(path + ".crc32") as fh:
+                    stored = fh.read().strip()
+            except OSError:
+                return (done, path)
+            try:
+                with open(path, "rb") as fh:
+                    actual = f"{zlib.crc32(fh.read()) & 0xFFFFFFFF:08x}"
+            except OSError as e:
+                warn_once(f"gbdt.checkpoint_bitrot.{path}",
+                          "checkpoint %s unreadable (%s: %s); resuming "
+                          "from the previous one", path,
+                          type(e).__name__, e)
+                continue
+            if actual != stored:
+                warn_once(f"gbdt.checkpoint_bitrot.{path}",
+                          "checkpoint %s fails its crc32 digest "
+                          "(sidecar %s, on disk %s) — silent bit-rot; "
+                          "resuming from the previous checkpoint", path,
+                          stored, actual)
+                continue
+            return (done, path)
+        return None
 
 
 class BinnedServingUnsupported(RuntimeError):

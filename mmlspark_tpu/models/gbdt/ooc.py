@@ -65,8 +65,8 @@ from mmlspark_tpu.core.logging_utils import warn_once
 from mmlspark_tpu.models.gbdt import objectives as obj_mod
 from mmlspark_tpu.models.gbdt import trainer as trainer_mod
 from mmlspark_tpu.models.gbdt.trainer import TrainConfig, TrainResult
-from mmlspark_tpu.ops.ingest import (ChunkStore, SpillReader, SpillWriter,
-                                     binned_ingest_dtype)
+from mmlspark_tpu.ops.ingest import (ChunkStore, SpillCorrupt, SpillReader,
+                                     SpillWriter, binned_ingest_dtype)
 from mmlspark_tpu.parallel import resilience
 from mmlspark_tpu.parallel.prefetch import BatchPrefetcher
 
@@ -332,12 +332,16 @@ def train_from_binned(binned: np.ndarray, labels: np.ndarray,
             for s in range(0, n, chunk_rows):
                 writer.append(np.asarray(binned[s:s + chunk_rows]))
             spill = writer.finalize()
+        # the caller's matrix outlives the spill: a chunk that fails
+        # its checksum mid-fit is re-derived from it bitwise
         return train_ooc(spill, labels, cfg, weights=weights,
                          bin_upper=bin_upper, init_model=init_model,
                          init_raw=init_raw, callbacks=callbacks,
                          measures=measures,
                          iteration_offset=iteration_offset,
-                         work_dir=os.path.join(tmp, "state"))
+                         work_dir=os.path.join(tmp, "state"),
+                         source=lambda i: np.asarray(
+                             binned[i * chunk_rows:(i + 1) * chunk_rows]))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -346,7 +350,9 @@ def train_ooc(spill: SpillReader, labels, cfg: TrainConfig, *,
               weights=None, bin_upper: Optional[np.ndarray] = None,
               init_model=None, init_raw=None, callbacks=None,
               measures=None, iteration_offset: int = 0,
-              work_dir: Optional[str] = None) -> TrainResult:
+              work_dir: Optional[str] = None,
+              source: Optional[Callable[[int], np.ndarray]] = None
+              ) -> TrainResult:
     """Chunked boosting over a sealed spill directory (see module doc).
 
     ``labels`` / ``weights`` / ``init_raw`` are either full (N,) arrays
@@ -355,6 +361,14 @@ def train_ooc(spill: SpillReader, labels, cfg: TrainConfig, *,
     larger-than-memory fit never materializes any full-N array.
     ``work_dir`` holds the per-chunk carry / quanta / node-id state
     (defaults to a temp directory removed on exit).
+
+    ``source``, when given, maps a chunk index back to its binned rows
+    (the iterator that fed the :class:`SpillWriter`): a spill chunk
+    failing its crc32 is then re-derived and rewritten bitwise —
+    binning is deterministic on fixed sketch edges — instead of
+    raising; without it the attributed
+    :class:`~mmlspark_tpu.ops.ingest.SpillCorrupt` propagates, naming
+    the chunk.
     """
     import jax
 
@@ -454,6 +468,24 @@ def train_ooc(spill: SpillReader, labels, cfg: TrainConfig, *,
             else:
                 carry_st.put(i, np.full(rows[i], base_score, np.float32))
 
+    def read_binned(i):
+        """Spill read with detect-and-repair: a chunk failing its
+        checksum is re-derived from ``source`` (bitwise — runs on the
+        prefetcher's producer thread, so repair cost overlaps compute
+        like any other read)."""
+        try:
+            return spill.read(i)
+        except SpillCorrupt as e:
+            if source is None:
+                raise
+            warn_once(
+                "gbdt.ooc.spill_repair",
+                "spill chunk %s failed verification (%s); re-deriving "
+                "it from the source chunk iterator — repairs are "
+                "bitwise, the fit continues", i, e)
+            spill.repair(i, source(i))
+            return spill.read(i)
+
     def sweep(*loaders):
         """Prefetched (i, *chunk arrays) stream over the spill order."""
         def gen():
@@ -473,10 +505,12 @@ def train_ooc(spill: SpillReader, labels, cfg: TrainConfig, *,
     trees_cnt: List[np.ndarray] = []
 
     def _boost_loop():
+        trainer_mod._clear_callback_failure()
         with resilience.fit_watchdog("gbdt.train_ooc"):
             for t in range(cfg.num_iterations):
                 it = t + iteration_offset
                 resilience.step_start(it)
+                trainer_mod._check_callback_failure()
                 fault_point("gbdt.train_step")
                 with measures.phase("training"):
                     _boost_one_tree(t)
@@ -485,6 +519,9 @@ def train_ooc(spill: SpillReader, labels, cfg: TrainConfig, *,
                     for cb in callbacks:
                         cb(t, record)
                 resilience.step_end()
+        # a swallowed host-callback failure on the final tree must
+        # abort here, before the ensemble is returned or checkpointed
+        trainer_mod._check_callback_failure()
 
     def _boost_one_tree(t):
         # -- pass 1: global grad/hess amax -> pow2 scales -------------
@@ -519,7 +556,7 @@ def train_ooc(spill: SpillReader, labels, cfg: TrainConfig, *,
 
             # -- chunk pass: route level d-1, histogram level d -------
             if d == 0:
-                with sweep(spill.read, carry_st.get, get_labels,
+                with sweep(read_binned, carry_st.get, get_labels,
                            get_w) as pf:
                     for i, bn, carry, y, w in pf:
                         gq, hq = jax.device_get(gh_quant(
@@ -531,7 +568,7 @@ def train_ooc(spill: SpillReader, labels, cfg: TrainConfig, *,
                         _accumulate_hist(acc, bn, local, gate, gq, hq, b)
             else:
                 node_ld = node_st.get if d > 1 else zeros_node
-                with sweep(spill.read, node_ld, gq_st.get,
+                with sweep(read_binned, node_ld, gq_st.get,
                            hq_st.get) as pf:
                     for i, bn, node, gq, hq in pf:
                         node = _route_level(node, bn, d - 1, route[d - 1])
@@ -586,7 +623,7 @@ def train_ooc(spill: SpillReader, labels, cfg: TrainConfig, *,
             ls, w_ = 2 ** dd - 1, 2 ** dd
             bgl_t[ls:ls + w_] = (route[dd]["left_mask"]
                                  & route[dd]["do_split"][:, None])
-        with sweep(spill.read, carry_st.get) as pf:
+        with sweep(read_binned, carry_st.get) as pf:
             for i, bn, carry in pf:
                 carry_st.put(i, np.asarray(jax.device_get(
                     carry_fn(carry, bn, sf_t, bgl_t, nv_t, lr))))
@@ -609,11 +646,19 @@ def train_ooc(spill: SpillReader, labels, cfg: TrainConfig, *,
         (trees_sf, trees_tb, trees_nv, trees_cnt, [], []),
         [1.0] * len(trees_sf), cfg, k, f, b, depth, num_slots,
         bin_upper, base_score, -1, init_model)
+    stores = (carry_st, gq_st, hq_st, node_st)
     hist_stats: Dict[str, object] = {
         "grow_policy": "depthwise", "hist_quant": quant,
         "hist_shard": "off", "grad_shard": "off",
         "efb_bundles": 0, "efb_bundled_features": 0,
         "ooc": True, "ooc_reason": None, "chunk_rows": chunk_rows,
-        "n_chunks": nc, "hist_subtract": subtract}
+        "n_chunks": nc, "hist_subtract": subtract,
+        "spill_verify": spill.verify_mode,
+        "spill_verify_s": round(
+            spill.verify_s + sum(st.verify_s for st in stores), 6),
+        "spill_verify_chunks": int(
+            spill.verify_chunks + sum(st.verify_chunks
+                                      for st in stores)),
+        "spill_repairs": int(spill.repairs)}
     return TrainResult(booster=booster, evals=[], best_iteration=-1,
                        hist_stats=hist_stats)

@@ -607,6 +607,41 @@ def _warn_async_callback_hazard() -> None:
 
 _NATIVE_HIST_PRIM = None
 
+# XLA swallows exceptions raised inside the raw emit_python_callback
+# host callbacks (the runtime logs them and leaves the result buffer
+# uninitialized), so a failing native kernel would otherwise surface
+# much later as an anonymous crash on garbage data. The latch records
+# the first failure, the callback hands XLA a benign zero histogram,
+# and the boosting loops re-raise the latched error — attributed, with
+# the original exception chained — at the next per-iteration host sync
+# (and once more after the loop, so a failure on the final iteration
+# cannot be checkpointed into a poisoned segment).
+_CALLBACK_FAILURE: List[BaseException] = []
+
+
+class CallbackFailed(RuntimeError):
+    """A native-histogram host callback raised mid-execution; the fit
+    aborts at the next host sync with the original error chained."""
+
+
+def _latch_callback_failure(e: BaseException) -> None:
+    if not _CALLBACK_FAILURE:
+        _CALLBACK_FAILURE.append(e)
+
+
+def _clear_callback_failure() -> None:
+    _CALLBACK_FAILURE.clear()
+
+
+def _check_callback_failure() -> None:
+    if _CALLBACK_FAILURE:
+        e = _CALLBACK_FAILURE[0]
+        _CALLBACK_FAILURE.clear()
+        raise CallbackFailed(
+            "[native.callback] native histogram host callback failed "
+            f"mid-fit ({type(e).__name__}: {e}); aborting before the "
+            "zero-histogram fallback tree can be committed") from e
+
 
 def _native_hist_primitive():
     """Raw-callback primitive for the native histogram on jax 0.4.x.
@@ -640,10 +675,16 @@ def _native_hist_primitive():
         # host-callback boundary: an armed delay here simulates a hung
         # native kernel (the failure mode the raw-callback redesign
         # exists to avoid), a corrupt simulates bad kernel output
-        fault_point("native.callback")
-        from mmlspark_tpu.native import bindings
-        with resilience.boundary("host_callback", "native.level_histogram"):
-            return bindings.level_histogram(bn, g, h, lv, lo, width, n_bins)
+        try:
+            fault_point("native.callback")
+            from mmlspark_tpu.native import bindings
+            with resilience.boundary("host_callback",
+                                     "native.level_histogram"):
+                return bindings.level_histogram(bn, g, h, lv, lo, width,
+                                                n_bins)
+        except BaseException as e:  # XLA would swallow it — latch it
+            _latch_callback_failure(e)
+            return np.zeros((width, bn.shape[1], n_bins, 3), np.float32)
 
     def _abstract(binned, grad, hess, live, local, *, width, n_bins):
         return jcore.ShapedArray((width, binned.shape[1], n_bins, 3),
@@ -701,12 +742,19 @@ def _native_level_histogram(binned, grad, hess, live, local, width, f, b):
     _warn_async_callback_hazard()
 
     def _cb(bn, g, h, lv, lo, _w=width, _b=b):
-        fault_point("native.callback")
-        from mmlspark_tpu.native import bindings
-        with resilience.boundary("host_callback", "native.level_histogram"):
-            return bindings.level_histogram(np.asarray(bn), np.asarray(g),
-                                            np.asarray(h), np.asarray(lv),
-                                            np.asarray(lo), _w, _b)
+        try:
+            fault_point("native.callback")
+            from mmlspark_tpu.native import bindings
+            with resilience.boundary("host_callback",
+                                     "native.level_histogram"):
+                return bindings.level_histogram(
+                    np.asarray(bn), np.asarray(g), np.asarray(h),
+                    np.asarray(lv), np.asarray(lo), _w, _b)
+        except BaseException as e:
+            # latch AND re-raise: pure_callback propagates on some jax
+            # versions and swallows on others — both end attributed
+            _latch_callback_failure(e)
+            raise
 
     # under shard_map the per-shard result varies over whatever mesh
     # axes the inputs vary over; declare the union when this jax
@@ -792,18 +840,23 @@ def _native_hist_primitive_v2():
 
     def _run(first, g, h, lv, lo, *scales, width, n_bins, num_features,
              quant, has_token):
-        fault_point("native.callback")
-        from mmlspark_tpu.native import bindings
-        with resilience.boundary("host_callback", "native.level_histogram"):
-            bn = (_host_binned_lookup(int(np.asarray(first))) if has_token
-                  else np.asarray(first))
-            if quant == "off":
-                return bindings.level_histogram(bn, g, h, lv, lo, width,
-                                                n_bins)
-            gsi, hsi = scales
-            return bindings.level_histogram_quant(
-                bn, g, h, lv, lo, width, n_bins,
-                float(np.asarray(gsi)), float(np.asarray(hsi)))
+        try:
+            fault_point("native.callback")
+            from mmlspark_tpu.native import bindings
+            with resilience.boundary("host_callback",
+                                     "native.level_histogram"):
+                bn = (_host_binned_lookup(int(np.asarray(first)))
+                      if has_token else np.asarray(first))
+                if quant == "off":
+                    return bindings.level_histogram(bn, g, h, lv, lo,
+                                                    width, n_bins)
+                gsi, hsi = scales
+                return bindings.level_histogram_quant(
+                    bn, g, h, lv, lo, width, n_bins,
+                    float(np.asarray(gsi)), float(np.asarray(hsi)))
+        except BaseException as e:  # XLA would swallow it — latch it
+            _latch_callback_failure(e)
+            return np.zeros((width, num_features, n_bins, 3), np.float32)
 
     def _abstract(first, g, h, lv, lo, *scales, width, n_bins,
                   num_features, quant, has_token):
@@ -857,15 +910,23 @@ def _native_level_histogram_v2(binned, grad, hess, live, local, width,
     _warn_async_callback_hazard()
 
     def _cb(*args, _w=width, _b=b, _q=quant, _tok=token is not None):
-        fault_point("native.callback")
-        from mmlspark_tpu.native import bindings
-        with resilience.boundary("host_callback", "native.level_histogram"):
-            host = [np.asarray(a) for a in args]
-            bn = _host_binned_lookup(int(host[0])) if _tok else host[0]
-            if _q == "off":
-                return bindings.level_histogram(bn, *host[1:5], _w, _b)
-            return bindings.level_histogram_quant(
-                bn, *host[1:5], _w, _b, float(host[5]), float(host[6]))
+        try:
+            fault_point("native.callback")
+            from mmlspark_tpu.native import bindings
+            with resilience.boundary("host_callback",
+                                     "native.level_histogram"):
+                host = [np.asarray(a) for a in args]
+                bn = (_host_binned_lookup(int(host[0])) if _tok
+                      else host[0])
+                if _q == "off":
+                    return bindings.level_histogram(bn, *host[1:5],
+                                                    _w, _b)
+                return bindings.level_histogram_quant(
+                    bn, *host[1:5], _w, _b, float(host[5]),
+                    float(host[6]))
+        except BaseException as e:
+            _latch_callback_failure(e)
+            raise
 
     from mmlspark_tpu.core.jax_compat import (operand_vma,
                                               shape_dtype_struct)
@@ -2381,13 +2442,30 @@ def train(binned: np.ndarray, labels: np.ndarray, cfg: TrainConfig,
                     or n >= env_int("MMLSPARK_TPU_OOC_ROWS", 4_000_000,
                                     minimum=1))
         if want_ooc and ooc_reason is None:
+            from mmlspark_tpu.core.serialize import DiskFull
             from mmlspark_tpu.models.gbdt import ooc as ooc_mod
-            return ooc_mod.train_from_binned(
-                binned, labels, cfg, weights=weights, bin_upper=bin_upper,
-                init_model=init_model, init_raw=init_raw,
-                callbacks=callbacks, measures=measures,
-                iteration_offset=iteration_offset)
-        if want_ooc and ooc_reason is not None and ooc_mode == "on":
+            try:
+                return ooc_mod.train_from_binned(
+                    binned, labels, cfg, weights=weights,
+                    bin_upper=bin_upper,
+                    init_model=init_model, init_raw=init_raw,
+                    callbacks=callbacks, measures=measures,
+                    iteration_offset=iteration_offset)
+            except DiskFull as e:
+                # the spill disk filled up, but this entry point was
+                # handed the full binned matrix — the rows fit in
+                # memory, so degrade to the in-core path instead of
+                # killing the fit (truly larger-than-memory fits enter
+                # via train_ooc directly and keep the hard error)
+                from mmlspark_tpu.core.logging_utils import warn_once
+                warn_once(
+                    "gbdt.ooc.disk_full",
+                    "out-of-core spill hit a full disk (%s); the rows "
+                    "already fit in memory, so this fit continues "
+                    "IN-CORE — free spill space to restore chunked "
+                    "training", e)
+                ooc_reason = "io.disk_full: spill write failed"
+        elif want_ooc and ooc_mode == "on":
             global _WARNED_OOC_DOWNGRADE
             if not _WARNED_OOC_DOWNGRADE:
                 _WARNED_OOC_DOWNGRADE = True
@@ -2869,12 +2947,14 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
         return False
 
     it = 0
+    _clear_callback_failure()
     while it < total:
         # per-iteration injection point (host side, outside the jitted
         # step): arming a raise here is the deterministic stand-in for
         # a preempted worker mid-fit — the kill-and-resume parity test
         # interrupts exactly here and resumes from the last checkpoint
         resilience.step_start(it + iteration_offset)
+        _check_callback_failure()
         fault_point("gbdt.train_step")
         fault_point("train.participant_loss")
         with measures.phase("training"):
@@ -2909,6 +2989,7 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
                 if feed_stop_rule(it):
                     break
         resilience.step_end()
+    _check_callback_failure()
 
     kept = outs[:stop_after]
     trees_sf: List[np.ndarray] = []
@@ -2928,6 +3009,9 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
     resilience.step_start("drain")
     with measures.phase("training"):
         jax.block_until_ready(carry)  # drain async dispatches
+    # async dispatch: the last steps' callbacks only ran during the
+    # drain, so a latched callback failure is first visible here
+    _check_callback_failure()
     # jit-boundary exit guard: raw scores after the last fused step
     sanitizer.check_finite("gbdt.train_scan.exit", carry)
     with measures.phase("validation"):
@@ -3037,9 +3121,11 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
                or cfg.neg_bagging_fraction < 1.0)
     labels_host = np.asarray(labels_d) if pos_neg else None
     bag_mask = rv_host.copy()
+    _clear_callback_failure()
     for it in range(cfg.num_iterations):
         # same per-iteration injection point as the fused path
         resilience.step_start(it + iteration_offset)
+        _check_callback_failure()
         fault_point("gbdt.train_step")
         fault_point("train.participant_loss")
         # ----- sampling masks (host RNG, deterministic by seed) ----------
@@ -3219,6 +3305,8 @@ def _train_loop(cfg, k, num_f, total_bins, depth, binned_d, labels_d,
                 if rounds_no_improve >= cfg.early_stopping_round:
                     break
         resilience.step_end()
+    # a failure on the final iteration must not be checkpointed away
+    _check_callback_failure()
 
     return ((trees_sf, trees_tb, trees_nv, trees_cnt, trees_dt, trees_bgl),
             tree_weights, evals, best_iter)

@@ -138,3 +138,72 @@ class TestCheckpointProtocol:
             faults.reset()
         # nothing half-written got committed
         assert load_latest_checkpoint(str(tmp_path), "h1") is None
+
+
+class TestCheckpointIntegrity:
+    """Payload digests in the manifest: bit-rot (not just torn writes)
+    is detected at load and the loader falls back one committed
+    generation with an attributed warning."""
+
+    STATE = {"weights": np.arange(8, dtype=np.float32), "bias": 1.5}
+
+    def test_manifest_records_payload_digest(self, tmp_path):
+        save_checkpoint(str(tmp_path), 1, self.STATE, "h1")
+        with open(tmp_path / "ckpt_00000001.json") as fh:
+            manifest = json.load(fh)
+        assert isinstance(manifest["payloadCrc32"], int)
+        assert manifest["payloadBytes"] == os.path.getsize(
+            tmp_path / "ckpt_00000001.npz")
+
+    def test_bitflip_falls_back_one_generation(self, tmp_path, caplog):
+        reset_warn_once()
+        d = str(tmp_path)
+        save_checkpoint(d, 1, self.STATE, "h1")
+        save_checkpoint(d, 2, {"weights": np.ones(4), "bias": 9.0}, "h1")
+        # flip one payload byte: np.load would still succeed, only the
+        # digest can catch this
+        npz = os.path.join(d, "ckpt_00000002.npz")
+        with open(npz, "r+b") as fh:
+            fh.seek(-7, os.SEEK_END)
+            b = fh.read(1)
+            fh.seek(-1, os.SEEK_CUR)
+            fh.write(bytes([b[0] ^ 0xFF]))
+        with caplog.at_level("WARNING"):
+            tag, state = load_latest_checkpoint(d, "h1")
+        assert tag == 1
+        np.testing.assert_array_equal(state["weights"],
+                                      self.STATE["weights"])
+        msgs = " ".join(r.getMessage() for r in caplog.records)
+        assert "crc32" in msgs or "bit-rot" in msgs
+
+    def test_verify_off_skips_digest(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_SPILL_VERIFY", "off")
+        d = str(tmp_path)
+        save_checkpoint(d, 2, self.STATE, "h1")
+        npz = os.path.join(d, "ckpt_00000002.npz")
+        with open(npz, "r+b") as fh:
+            fh.seek(-7, os.SEEK_END)
+            b = fh.read(1)
+            fh.seek(-1, os.SEEK_CUR)
+            fh.write(bytes([b[0] ^ 0xFF]))
+        # trust-the-disk mode: the digest is not consulted; the load
+        # either returns (possibly garbage) data or trips np.load's own
+        # structural checks — never the CheckpointCorrupt digest path
+        try:
+            out = load_latest_checkpoint(d, "h1")
+        except Exception as e:  # noqa: BLE001 — zip-level damage
+            assert "crc32" not in str(e)
+        else:
+            assert out is None or out[0] == 2
+
+    def test_validate_hook_rejection_falls_back(self, tmp_path):
+        reset_warn_once()
+        d = str(tmp_path)
+        save_checkpoint(d, 1, self.STATE, "h1")
+        save_checkpoint(d, 2, {"weights": np.ones(4), "bias": 9.0}, "h1")
+
+        def validate(tag, state):
+            return "model dir digest mismatch" if tag == 2 else None
+
+        tag, _ = load_latest_checkpoint(d, "h1", validate=validate)
+        assert tag == 1

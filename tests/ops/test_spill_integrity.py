@@ -1,0 +1,335 @@
+"""Integrity plane for on-disk artifacts: framed checksummed spill
+chunks (SpillWriter/SpillReader/ChunkStore), attributed SpillCorrupt on
+truncation / bad magic / bit-rot, verify-policy knob semantics,
+repair-from-source during an OOC fit, and DiskFull → in-core
+degradation with a one-shot warning."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.core.logging_utils import reset_warn_once
+from mmlspark_tpu.core.serialize import DiskFull
+from mmlspark_tpu.models.gbdt import trainer as T
+from mmlspark_tpu.ops.binning import BinMapper
+from mmlspark_tpu.ops.ingest import (ChunkStore, SpillCorrupt,
+                                     SpillReader, SpillWriter,
+                                     pack_frame, read_chunk,
+                                     resolve_spill_verify, write_chunk)
+
+pytestmark = pytest.mark.integrity_smoke
+
+_BOOSTER_ARRAYS = ("split_feature", "threshold_bin", "node_value",
+                   "count")
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    faults.reset()
+    reset_warn_once()
+    yield
+    faults.reset()
+
+
+def _flip_byte(path, offset=-3):
+    with open(path, "r+b") as fh:
+        fh.seek(offset, os.SEEK_END)
+        b = fh.read(1)
+        fh.seek(-1, os.SEEK_CUR)
+        fh.write(bytes([b[0] ^ 0xFF]))
+
+
+class TestFrame:
+    def test_roundtrip_bitwise(self, rng, tmp_path):
+        arr = rng.integers(0, 255, size=(37, 5)).astype(np.uint8)
+        path = str(tmp_path / "c.bin")
+        write_chunk(path, arr)
+        out, verify_s = read_chunk(path, chunk=0)
+        np.testing.assert_array_equal(out, arr)
+        assert out.dtype == arr.dtype and verify_s >= 0.0
+
+    def test_frame_is_checksummed(self, rng):
+        arr = rng.normal(size=(8, 3)).astype(np.float32)
+        frame = pack_frame(arr)
+        assert frame[:4] == b"MMSC"
+        assert b'"crc32"' in frame[:256]
+
+    def test_bitrot_payload_raises_attributed(self, rng, tmp_path):
+        arr = rng.integers(0, 255, size=(64, 4)).astype(np.uint8)
+        path = str(tmp_path / "c.bin")
+        write_chunk(path, arr)
+        _flip_byte(path)
+        with pytest.raises(SpillCorrupt, match="crc32 mismatch") as ei:
+            read_chunk(path, chunk=3)
+        assert ei.value.chunk == 3
+        assert ei.value.path == path
+
+    def test_truncated_payload_reports_byte_counts(self, rng, tmp_path):
+        arr = rng.integers(0, 255, size=(64, 4)).astype(np.uint8)
+        path = str(tmp_path / "c.bin")
+        write_chunk(path, arr)
+        size = os.path.getsize(path)
+        with open(path, "r+b") as fh:
+            fh.truncate(size - 100)
+        with pytest.raises(SpillCorrupt,
+                           match=r"expected \d+ bytes, found \d+"):
+            read_chunk(path, chunk=1)
+
+    def test_bad_magic_raises(self, tmp_path):
+        path = str(tmp_path / "c.bin")
+        with open(path, "wb") as fh:
+            fh.write(b"not a framed chunk at all")
+        with pytest.raises(SpillCorrupt, match="not a framed"):
+            read_chunk(path)
+
+    def test_missing_file_raises(self, tmp_path):
+        with pytest.raises(SpillCorrupt, match="missing or unreadable"):
+            read_chunk(str(tmp_path / "nope.bin"), chunk=7)
+
+    def test_verify_off_trusts_the_disk(self, rng, tmp_path):
+        """With verification skipped, a payload bit-flip loads silently
+        — that is exactly the failure mode the crc exists to catch."""
+        arr = rng.integers(0, 255, size=(64, 4)).astype(np.uint8)
+        path = str(tmp_path / "c.bin")
+        write_chunk(path, arr)
+        _flip_byte(path)
+        out, verify_s = read_chunk(path, verify=False)
+        assert verify_s == 0.0
+        assert not np.array_equal(out, arr)
+
+
+class TestVerifyPolicy:
+    @pytest.mark.parametrize("value,expected", [
+        (None, "auto"), ("auto", "auto"), ("on", "on"), ("off", "off"),
+        (" ON ", "on"),
+    ])
+    def test_modes(self, monkeypatch, value, expected):
+        if value is None:
+            monkeypatch.delenv("MMLSPARK_TPU_SPILL_VERIFY",
+                               raising=False)
+        else:
+            monkeypatch.setenv("MMLSPARK_TPU_SPILL_VERIFY", value)
+        assert resolve_spill_verify() == expected
+
+    def test_bad_value_warns_once_falls_back(self, monkeypatch, caplog):
+        monkeypatch.setenv("MMLSPARK_TPU_SPILL_VERIFY", "paranoid")
+        with caplog.at_level("WARNING"):
+            assert resolve_spill_verify() == "auto"
+            assert resolve_spill_verify() == "auto"
+        hits = [r for r in caplog.records
+                if "MMLSPARK_TPU_SPILL_VERIFY" in r.getMessage()]
+        assert len(hits) == 1
+
+    def test_auto_verifies_first_read_only(self, rng, tmp_path,
+                                           monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_SPILL_VERIFY", "auto")
+        sw = SpillWriter(str(tmp_path / "spill"))
+        sw.append(rng.integers(0, 200, size=(50, 3)).astype(np.uint8))
+        rd = sw.finalize()
+        rd.read(0)
+        assert rd.verify_chunks == 1
+        rd.read(0)
+        assert rd.verify_chunks == 1  # second read trusted
+
+    def test_on_verifies_every_read(self, rng, tmp_path, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_SPILL_VERIFY", "on")
+        sw = SpillWriter(str(tmp_path / "spill"))
+        sw.append(rng.integers(0, 200, size=(50, 3)).astype(np.uint8))
+        rd = sw.finalize()
+        rd.read(0)
+        rd.read(0)
+        assert rd.verify_chunks == 2
+
+
+class TestSpillReader:
+    def test_missing_manifest_attributed(self, tmp_path):
+        with pytest.raises(SpillCorrupt, match="manifest"):
+            SpillReader(str(tmp_path / "empty"))
+
+    def test_bitrot_then_repair_bitwise(self, rng, tmp_path,
+                                        monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_SPILL_VERIFY", "on")
+        chunks = [rng.integers(0, 200, size=(40, 4)).astype(np.uint8)
+                  for _ in range(3)]
+        sw = SpillWriter(str(tmp_path / "spill"))
+        for c in chunks:
+            sw.append(c)
+        rd = sw.finalize()
+        _flip_byte(os.path.join(str(tmp_path / "spill"),
+                                "chunk_000001.bin"))
+        with pytest.raises(SpillCorrupt, match="chunk 1"):
+            rd.read(1)
+        rd.repair(1, chunks[1])
+        np.testing.assert_array_equal(rd.read(1), chunks[1])
+        assert rd.repairs == 1
+
+    def test_repair_rejects_wrong_shape(self, rng, tmp_path):
+        sw = SpillWriter(str(tmp_path / "spill"))
+        sw.append(rng.integers(0, 200, size=(40, 4)).astype(np.uint8))
+        rd = sw.finalize()
+        with pytest.raises(ValueError, match="repair chunk 0"):
+            rd.repair(0, np.zeros((2, 2), dtype=np.uint8))
+
+
+class TestChunkStore:
+    def test_missing_chunk_names_store_and_index(self, tmp_path):
+        st = ChunkStore(str(tmp_path), "carry")
+        st.put(0, np.arange(6, dtype=np.float32))
+        with pytest.raises(SpillCorrupt, match="carry.*chunk 2"):
+            st.get(2)
+
+    def test_bitrot_attributed(self, rng, tmp_path, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_SPILL_VERIFY", "on")
+        st = ChunkStore(str(tmp_path), "grad")
+        arr = rng.normal(size=(32, 2)).astype(np.float32)
+        st.put(1, arr)
+        _flip_byte(str(tmp_path / "grad_000001.bin"))
+        with pytest.raises(SpillCorrupt, match="crc32 mismatch"):
+            st.get(1)
+
+    def test_put_get_roundtrip(self, rng, tmp_path):
+        st = ChunkStore(str(tmp_path), "hess")
+        arr = rng.normal(size=(32, 2)).astype(np.float32)
+        st.put(0, arr)
+        np.testing.assert_array_equal(st.get(0), arr)
+
+
+@pytest.mark.ooc_smoke
+def test_ooc_repair_from_source_bitwise(rng, tmp_path, monkeypatch):
+    """A spill chunk corrupted on disk mid-fit is re-derived from the
+    source chunk iterator and the fit finishes bitwise-identical to an
+    uncorrupted run, with the repair counted and warned once."""
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_QUANT", "q16")
+    monkeypatch.setenv("MMLSPARK_TPU_EFB", "off")
+    monkeypatch.setenv("MMLSPARK_TPU_OOC_CHUNK_ROWS", "1024")
+    monkeypatch.setenv("MMLSPARK_TPU_SPILL_VERIFY", "on")
+    monkeypatch.setenv("MMLSPARK_TPU_OOC", "on")
+    x = rng.normal(size=(2600, 6))
+    y = (x[:, 0] * 2 + np.sin(x[:, 1])).astype(np.float64)
+    bm = BinMapper.fit_streaming(iter([x[:1500], x[1500:]]), max_bin=31)
+    binned = bm.transform(x)
+    cfg = T.TrainConfig(objective="regression", num_iterations=4,
+                        max_depth=4, num_leaves=10, learning_rate=0.2,
+                        max_bin=31)
+    clean = T.train(binned, y, cfg)
+
+    # corrupt the framed payload of chunk 1 on its 4th read: the armed
+    # corrupt action mangles bytes exactly like disk bit-rot
+    reset_warn_once()
+
+    def _mangle(payload):
+        b = bytearray(payload)
+        b[len(b) // 2] ^= 0xFF
+        return bytes(b)
+
+    with faults.injected("spill.read", "corrupt", nth=4, count=1,
+                         corrupt=_mangle):
+        repaired = T.train(binned, y, cfg)
+    st = repaired.hist_stats
+    assert st["ooc"] is True
+    assert st["spill_verify"] == "on"
+    assert st["spill_repairs"] >= 1
+    assert st["spill_verify_chunks"] > 0
+    assert st["spill_verify_s"] >= 0.0
+    for name in _BOOSTER_ARRAYS:
+        np.testing.assert_array_equal(
+            getattr(clean.booster, name),
+            getattr(repaired.booster, name),
+            err_msg=f"booster.{name} diverged after repair")
+
+
+@pytest.mark.ooc_smoke
+def test_disk_full_downgrades_in_core_bitwise(rng, monkeypatch, caplog):
+    """ENOSPC on a spill write degrades the fit to the in-core path —
+    one warning, attributed reason, bitwise-identical model under the
+    parity pins."""
+    monkeypatch.setenv("MMLSPARK_TPU_HIST_QUANT", "q16")
+    monkeypatch.setenv("MMLSPARK_TPU_EFB", "off")
+    monkeypatch.setenv("MMLSPARK_TPU_OOC_CHUNK_ROWS", "1024")
+    x = rng.normal(size=(2600, 6))
+    y = (x[:, 0] * 2 + np.sin(x[:, 1])).astype(np.float64)
+    bm = BinMapper.fit_streaming(iter([x]), max_bin=31)
+    binned = bm.transform(x)
+    cfg = T.TrainConfig(objective="regression", num_iterations=4,
+                        max_depth=4, num_leaves=10, learning_rate=0.2,
+                        max_bin=31)
+    monkeypatch.setenv("MMLSPARK_TPU_OOC", "off")
+    clean = T.train(binned, y, cfg)
+
+    monkeypatch.setenv("MMLSPARK_TPU_OOC", "on")
+    reset_warn_once()
+    faults.arm("io.disk_full", "raise", nth=1, count=1,
+               exc=OSError(28, "No space left on device"))
+    try:
+        with caplog.at_level("WARNING"):
+            degraded = T.train(binned, y, cfg)
+    finally:
+        faults.reset()
+    st = degraded.hist_stats
+    assert st["ooc"] is False
+    assert "io.disk_full" in (st["ooc_reason"] or "")
+    warned = [r for r in caplog.records
+              if "disk" in r.getMessage().lower()]
+    assert warned, "expected a one-shot disk-full downgrade warning"
+    for name in _BOOSTER_ARRAYS:
+        np.testing.assert_array_equal(
+            getattr(clean.booster, name), getattr(degraded.booster, name),
+            err_msg=f"booster.{name} diverged after downgrade")
+
+
+def test_spill_write_disk_full_is_attributed(rng, tmp_path):
+    faults.arm("io.disk_full", "raise", nth=1, count=1,
+               exc=OSError(28, "No space left on device"))
+    try:
+        with pytest.raises(DiskFull, match=r"io\.disk_full"):
+            write_chunk(str(tmp_path / "c.bin"),
+                        rng.integers(0, 9, size=(4, 4)).astype(np.uint8))
+    finally:
+        faults.reset()
+    assert not os.path.exists(str(tmp_path / "c.bin"))
+
+
+class TestEstimatorCheckpointSidecar:
+    """crc32 sidecars on the estimator's ``checkpoint_N.txt`` segments:
+    a bit-rotted newest segment is skipped with an attributed warn-once
+    and the scan falls back one generation; sidecar-less segments
+    (pre-integrity runs) are accepted unverified."""
+
+    @staticmethod
+    def _seed(ckpt_dir, done, text):
+        import zlib
+        path = os.path.join(ckpt_dir, f"checkpoint_{done}.txt")
+        with open(path, "w") as fh:
+            fh.write(text)
+        with open(path + ".crc32", "w") as fh:
+            fh.write(f"{zlib.crc32(text.encode()) & 0xFFFFFFFF:08x}")
+        return path
+
+    def test_bitrot_falls_back_one_generation(self, tmp_path, caplog):
+        from mmlspark_tpu.models.gbdt.estimators import _LightGBMBase
+        self._seed(str(tmp_path), 2, "tree v2")
+        newest = self._seed(str(tmp_path), 4, "tree v4")
+        _flip_byte(newest, offset=-2)
+        with caplog.at_level("WARNING"):
+            got = _LightGBMBase._latest_checkpoint(str(tmp_path))
+        assert got is not None and got[0] == 2
+        assert got[1].endswith("checkpoint_2.txt")
+        assert any("crc32" in r.getMessage() for r in caplog.records)
+
+    def test_missing_sidecar_accepted(self, tmp_path):
+        from mmlspark_tpu.models.gbdt.estimators import _LightGBMBase
+        path = os.path.join(str(tmp_path), "checkpoint_3.txt")
+        with open(path, "w") as fh:
+            fh.write("tree v3")
+        got = _LightGBMBase._latest_checkpoint(str(tmp_path))
+        assert got == (3, path)
+
+    def test_verify_off_accepts_rotten(self, tmp_path, monkeypatch):
+        from mmlspark_tpu.models.gbdt.estimators import _LightGBMBase
+        newest = self._seed(str(tmp_path), 1, "tree v1")
+        _flip_byte(newest, offset=-2)
+        monkeypatch.setenv("MMLSPARK_TPU_SPILL_VERIFY", "off")
+        got = _LightGBMBase._latest_checkpoint(str(tmp_path))
+        assert got == (1, newest)

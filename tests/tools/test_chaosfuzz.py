@@ -1,0 +1,164 @@
+"""The chaos-fuzz harness itself: action profiles derived from the
+canonical fault registry (future points are fuzzed automatically),
+seeded schedule determinism, the attribution classifier, and a mini
+end-to-end campaign that must finish with zero violations."""
+
+import json
+import random
+import subprocess
+import sys
+
+import pytest
+
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.core.faults import FaultInjected
+from mmlspark_tpu.core.serialize import DiskFull
+from mmlspark_tpu.ops.ingest import SpillCorrupt
+
+from tools import chaosfuzz as cf
+from tools.chaosfuzz import scenarios as sc
+
+pytestmark = pytest.mark.chaosfuzz
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class TestProfiles:
+    def test_every_registered_point_has_a_profile(self):
+        """New-fault-point completeness: a point registered in
+        KNOWN_POINTS is fuzzable with no chaosfuzz edit — the profile
+        map is derived from the registry at runtime."""
+        profs = cf.profiles()
+        assert set(profs) == set(faults.KNOWN_POINTS)
+
+    def test_actions_are_valid_and_corrupt_is_gated(self):
+        profs = cf.profiles()
+        for point, prof in profs.items():
+            assert set(prof.actions) <= {"raise", "delay", "corrupt"}
+            assert "raise" in prof.actions and "delay" in prof.actions
+            # corrupt only where the value has a detect-and-recover
+            # contract (checksummed spill payloads, probed swaps)
+            if "corrupt" in prof.actions:
+                assert point in ("spill.read", "registry.swap")
+
+    def test_schedules_cover_whole_registry_eventually(self):
+        """The sampler's 20% full-registry tail means a long campaign
+        arms points outside every scenario's affinity set."""
+        profs = cf.profiles()
+        scen = sc.all_scenarios()[0]
+        rng = random.Random(0)
+        armed = set()
+        for _ in range(2000):
+            for p, _, _ in cf.sample_schedule(rng, scen, profs):
+                armed.add(p)
+        assert armed == set(faults.KNOWN_POINTS)
+
+    def test_arm_schedule_fires_exactly_once(self):
+        cf.arm_schedule((("gbdt.train_step", "raise", 1),))
+        with pytest.raises(FaultInjected):
+            faults.fault_point("gbdt.train_step")
+        # count=1: the second hit passes through
+        faults.fault_point("gbdt.train_step")
+        assert faults.fired("gbdt.train_step") == 1
+
+
+class TestDeterminism:
+    def test_same_seed_same_schedules(self):
+        profs = cf.profiles()
+        for scen in sc.all_scenarios():
+            a = [cf.sample_schedule(random.Random(7), scen, profs)
+                 for _ in range(1)]
+            b = [cf.sample_schedule(random.Random(7), scen, profs)
+                 for _ in range(1)]
+            assert a == b
+
+    def test_different_seeds_differ(self):
+        profs = cf.profiles()
+        scen = sc.all_scenarios()[0]
+        seqs = set()
+        for seed in range(20):
+            rng = random.Random(seed)
+            seqs.add(tuple(cf.sample_schedule(rng, scen, profs)
+                           for _ in range(5)))
+        assert len(seqs) > 1
+
+
+class TestAttribution:
+    SCHEDULE = (("io.disk_full", "raise", 1), ("spill.read", "corrupt", 2))
+
+    def test_fault_injected_is_attributed(self):
+        assert cf.is_attributed(FaultInjected("injected fault at 'x'"),
+                                self.SCHEDULE)
+
+    def test_typed_contract_errors_are_attributed(self):
+        assert cf.is_attributed(DiskFull("write failed"), self.SCHEDULE)
+        assert cf.is_attributed(SpillCorrupt("crc32 mismatch"),
+                                self.SCHEDULE)
+
+    def test_wrapped_cause_chain_is_walked(self):
+        try:
+            try:
+                raise FaultInjected("injected fault at 'io.disk_full'")
+            except FaultInjected as inner:
+                raise RuntimeError("opaque wrapper") from inner
+        except RuntimeError as e:
+            assert cf.is_attributed(e, self.SCHEDULE)
+
+    def test_anonymous_error_is_not_attributed(self):
+        assert not cf.is_attributed(
+            IndexError("index 947912704 is out of bounds"),
+            self.SCHEDULE)
+
+    def test_point_named_in_message_is_attributed(self):
+        assert cf.is_attributed(
+            RuntimeError("commit failed: io.disk_full tripped"),
+            self.SCHEDULE)
+
+    def test_scenario_verdict_overrules_chain(self):
+        """Unattributed is the scenario's own 'NOT explained' verdict;
+        a FaultInjected deeper in the chain must not mask it."""
+        try:
+            try:
+                raise FaultInjected("injected fault at 'serving.score'")
+            except FaultInjected as inner:
+                raise sc.Unattributed("reply diverged") from inner
+        except sc.Unattributed as e:
+            assert not cf.is_attributed(e, self.SCHEDULE)
+
+
+class TestScenarios:
+    def test_scenario_affinities_are_registered_points(self):
+        for scen in sc.all_scenarios():
+            unknown = set(scen.affinity) - set(faults.KNOWN_POINTS)
+            assert not unknown, (
+                f"{scen.name} affinity names unregistered points "
+                f"{sorted(unknown)}")
+
+    def test_reply_comparator_is_subset_bitwise(self):
+        base = {"replies": {"0": 1.5, "1": 2.5}}
+        assert sc._compare_replies(base, {"replies": {"0": 1.5}}) is None
+        assert sc._compare_replies(base, {"replies": {"0": 1.0}})
+        assert sc._compare_replies(base, {"replies": {"9": 1.5}})
+
+
+@pytest.mark.slow
+def test_mini_campaign_zero_violations(tmp_path):
+    """End-to-end: a 6-schedule campaign through the module CLI upholds
+    every invariant and reports per-point coverage for the whole
+    registry."""
+    report_path = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.chaosfuzz", "--seed", "11",
+         "--schedules", "6", "--budget", "120",
+         "--report", str(report_path)],
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(report_path.read_text())
+    assert report["total_schedules"] == 6
+    assert report["violations"] == []
+    assert set(report["points"]) == set(faults.KNOWN_POINTS)

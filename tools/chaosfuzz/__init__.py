@@ -1,0 +1,336 @@
+"""Seeded chaos-fuzz campaign over every registered fault point.
+
+``python -m tools.chaosfuzz --seed N --budget S`` samples deterministic
+fault *schedules* — (point, action, nth-hit) tuples drawn from the
+canonical ``mmlspark_tpu.core.faults.KNOWN_POINTS`` registry — and runs
+each against a small end-to-end scenario (in-core fit, out-of-core fit,
+streaming refresh, serving swap), asserting the framework's resilience
+invariants:
+
+  1. **no hang** — every schedule completes (or is aborted and counted
+     as a violation) within the watchdog budget, enforced with
+     :func:`mmlspark_tpu.parallel.resilience.stall_guard`;
+  2. **attribution** — a schedule that fails must fail with a *typed,
+     attributed* error (one naming the injected fault point, or the
+     point's contractual error type: ``DiskFull`` for ``io.disk_full``,
+     ``SpillCorrupt`` for a corrupted ``spill.read``, ``SwapFailed``
+     for ``registry.swap``); anonymous stack traces are violations;
+  3. **recovery is bitwise** — a schedule that completes (first try or
+     after one resume in the same work dir) must produce a fingerprint
+     identical to the unfaulted baseline.
+
+Action profiles are derived from ``KNOWN_POINTS`` *at runtime*, so a
+fault point added in a future PR is fuzzed automatically with the
+default raise/delay actions — no chaosfuzz edit required (pinned by
+tests/tools/test_chaosfuzz.py).  ``corrupt`` is only sampled where the
+value flowing through the point has a detect-and-recover contract
+(spill payload checksums, swap probe + rollback).
+
+The campaign pins the trainer's parity knobs (q16 histogram
+quantisation, EFB off, verification ``on``) so out-of-core, resumed and
+degraded-to-in-core runs are bitwise-comparable to their baselines.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import random
+import shutil
+import tempfile
+import time
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.core.env import env_override
+
+from tools.chaosfuzz import scenarios as _scen
+from tools.chaosfuzz.scenarios import Scenario, Unattributed
+
+__all__ = ["ActionProfile", "profiles", "sample_schedule",
+           "is_attributed", "run_campaign", "Schedule"]
+
+# one armed fault: (point, action, nth-hit-that-triggers)
+Arm = Tuple[str, str, int]
+Schedule = Tuple[Arm, ...]
+
+_DELAY_S = 0.2
+
+
+@dataclass(frozen=True)
+class ActionProfile:
+    """How a fault point may be armed by the fuzzer."""
+    actions: Tuple[str, ...]
+    # typed error the point's contract promises when it trips for real;
+    # an exception chain containing it counts as attributed
+    typed_error: Optional[str] = None
+
+
+def _flip_payload(value):
+    """Corrupt callable for ``spill.read``: flip one byte of the framed
+    payload so checksum verification must catch it."""
+    if isinstance(value, (bytes, bytearray)) and len(value):
+        b = bytearray(value)
+        b[len(b) // 2] ^= 0xFF
+        return bytes(b)
+    return value
+
+
+def _break_served(value):
+    """Corrupt callable for ``registry.swap``: break the freshly-built
+    served model so the probe fails and the swap must roll back."""
+    try:
+        value.model = None
+    except Exception:
+        pass
+    return value
+
+
+_CORRUPTORS = {"spill.read": _flip_payload, "registry.swap": _break_served}
+
+# points whose raise-action should simulate the OS-level failure their
+# guard translates (ENOSPC), driving the except-OSError degradation
+# paths as well as the FaultInjected ones
+_ENOSPC_POINTS = ("io.disk_full",)
+
+_TYPED_ERRORS = {
+    "io.disk_full": "DiskFull",
+    "spill.read": "SpillCorrupt",
+    "registry.swap": "SwapFailed",
+    "checkpoint.write": "CheckpointCorrupt",
+}
+
+
+def profiles() -> Dict[str, ActionProfile]:
+    """Action profile per registered fault point, derived from
+    ``KNOWN_POINTS`` so new points are covered the moment they are
+    registered."""
+    out: Dict[str, ActionProfile] = {}
+    for name in faults.KNOWN_POINTS:
+        actions: Tuple[str, ...] = ("raise", "delay")
+        if name in _CORRUPTORS:
+            actions = ("raise", "delay", "corrupt")
+        out[name] = ActionProfile(actions=actions,
+                                  typed_error=_TYPED_ERRORS.get(name))
+    return out
+
+
+def arm_schedule(schedule: Schedule) -> None:
+    """Arm every fault in ``schedule`` (each triggers exactly once)."""
+    for point, action, nth in schedule:
+        kwargs: dict = {"nth": nth, "count": 1, "delay_s": _DELAY_S}
+        if action == "corrupt":
+            kwargs["corrupt"] = _CORRUPTORS[point]
+        if action == "raise" and point in _ENOSPC_POINTS:
+            kwargs["exc"] = OSError(
+                28, f"injected disk-full at {point!r}")
+        faults.arm(point, action, **kwargs)
+
+
+def sample_schedule(rng: random.Random, scenario: Scenario,
+                    profs: Dict[str, ActionProfile]) -> Schedule:
+    """Draw a deterministic fault schedule: usually one fault, sometimes
+    two, biased toward points the scenario's code path can reach (so
+    armed faults usually fire) with a tail over the full registry (so
+    every point, including future ones, gets armed across a campaign)."""
+    all_points = sorted(profs)
+    n_faults = 1 if rng.random() < 0.7 else 2
+    arms: List[Arm] = []
+    used = set()
+    for _ in range(n_faults):
+        pool = (list(scenario.affinity) if rng.random() < 0.8
+                else all_points)
+        point = rng.choice(pool)
+        if point in used:
+            continue
+        used.add(point)
+        action = rng.choice(list(profs[point].actions))
+        nth = rng.randint(1, 3)
+        arms.append((point, action, nth))
+    return tuple(arms)
+
+
+# ---------------------------------------------------------------------------
+# attribution
+# ---------------------------------------------------------------------------
+
+def _chain(exc: BaseException):
+    seen = set()
+    stack = [exc]
+    while stack:
+        e = stack.pop()
+        if e is None or id(e) in seen:
+            continue
+        seen.add(id(e))
+        yield e
+        stack.append(e.__cause__)
+        stack.append(e.__context__)
+
+
+def is_attributed(exc: BaseException, schedule: Schedule,
+                  profs: Optional[Dict[str, ActionProfile]] = None
+                  ) -> bool:
+    """Does this failure name the fault that caused it?  True when the
+    exception chain carries the injection marker, mentions an armed
+    point by name, or is (or wraps) the typed error the armed point's
+    contract promises."""
+    profs = profs if profs is not None else profiles()
+    armed = {p for p, _, _ in schedule}
+    typed = {profs[p].typed_error for p in armed
+             if profs.get(p) and profs[p].typed_error}
+    links = list(_chain(exc))
+    if any(isinstance(e, Unattributed) for e in links):
+        # the scenario's own verdict: this failure is NOT explained by
+        # any armed fault — nothing else in the chain may overrule it
+        return False
+    for e in links:
+        if isinstance(e, faults.FaultInjected):
+            return True
+        text = f"{type(e).__name__}: {e}"
+        if "injected fault" in text or "injected disk-full" in text:
+            return True
+        if any(p in text for p in armed):
+            return True
+        if any(t.__name__ in typed for t in type(e).__mro__):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# campaign
+# ---------------------------------------------------------------------------
+
+# parity + verification pins: completed faulted runs (including resumes
+# and OOC→in-core downgrades) must be bitwise-comparable to baselines
+_ENV_PINS: Tuple[Tuple[str, Optional[str]], ...] = (
+    ("MMLSPARK_TPU_SPILL_VERIFY", "on"),
+    ("MMLSPARK_TPU_HIST_QUANT", "q16"),
+    ("MMLSPARK_TPU_EFB", "off"),
+    ("MMLSPARK_TPU_OOC", "off"),
+    ("MMLSPARK_TPU_FAULTS", None),
+    ("MMLSPARK_TPU_WATCHDOG_MULT", None),
+)
+
+
+def _run_guarded(scenario: Scenario, work_dir: str,
+                 armed: FrozenSet[str], budget_s: float) -> dict:
+    from mmlspark_tpu.parallel import resilience
+    with resilience.stall_guard(f"chaosfuzz.{scenario.name}",
+                                budget_s=budget_s,
+                                classification="chaosfuzz-hang"):
+        return scenario.run(work_dir, armed)
+
+
+def _is_hang(exc: BaseException) -> bool:
+    from mmlspark_tpu.parallel.resilience import TrainStalled
+    return any(isinstance(e, TrainStalled) and "chaosfuzz." in str(e)
+               for e in _chain(exc))
+
+
+def _run_schedule(scenario: Scenario, schedule: Schedule,
+                  baseline: dict, budget_s: float,
+                  profs: Dict[str, ActionProfile]) -> Tuple[str, str]:
+    """Run one schedule (arm → run → maybe resume once) and classify:
+    returns ``(outcome, detail)`` where outcome is ``clean`` |
+    ``resumed`` | ``failed-attributed`` | ``violation:<kind>``."""
+    armed = frozenset(p for p, _, _ in schedule)
+    work_dir = tempfile.mkdtemp(prefix=f"chaosfuzz-{scenario.name}-")
+    try:
+        arm_schedule(schedule)
+        first_error = None
+        attempts = 2 if scenario.resumable else 1
+        for attempt in range(1, attempts + 1):
+            try:
+                fingerprint = _run_guarded(scenario, work_dir, armed,
+                                           budget_s)
+            except BaseException as e:  # noqa: BLE001 — classifying
+                if _is_hang(e):
+                    return ("violation:hang",
+                            f"aborted at watchdog budget {budget_s}s: "
+                            f"{e}")
+                if not is_attributed(e, schedule, profs):
+                    return ("violation:unattributed",
+                            f"attempt {attempt}: {type(e).__name__}: "
+                            f"{e}")
+                first_error = e
+                continue
+            mismatch = scenario.compare(baseline, fingerprint)
+            if mismatch is not None:
+                return ("violation:diverged", mismatch)
+            return (("clean", "") if attempt == 1
+                    else ("resumed",
+                          f"resumed after {type(first_error).__name__}"))
+        return ("failed-attributed",
+                f"{type(first_error).__name__}: {first_error}")
+    finally:
+        shutil.rmtree(work_dir, ignore_errors=True)
+
+
+def run_campaign(seeds: Sequence[int], schedules_per_seed: int,
+                 budget_s: float,
+                 scenario_names: Optional[Sequence[str]] = None) -> dict:
+    """Run the full campaign and return the JSON-able report."""
+    profs = profiles()
+    scens = [s for s in _scen.all_scenarios()
+             if scenario_names is None or s.name in scenario_names]
+    if not scens:
+        raise ValueError(f"no scenarios selected from {scenario_names!r}")
+    coverage = {p: {"armed": 0, "hit": 0, "fired": 0}
+                for p in sorted(profs)}
+    runs: List[dict] = []
+    violations: List[dict] = []
+    t0 = time.perf_counter()
+    with contextlib.ExitStack() as stack:
+        for name, value in _ENV_PINS:
+            stack.enter_context(env_override(name, value))
+        faults.reset()
+        baselines: Dict[str, dict] = {}
+
+        def baseline_for(scenario: Scenario) -> dict:
+            if scenario.name not in baselines:
+                bdir = tempfile.mkdtemp(
+                    prefix=f"chaosfuzz-baseline-{scenario.name}-")
+                try:
+                    baselines[scenario.name] = _run_guarded(
+                        scenario, bdir, frozenset(), budget_s)
+                finally:
+                    shutil.rmtree(bdir, ignore_errors=True)
+            return baselines[scenario.name]
+
+        for seed in seeds:
+            rng = random.Random(seed)
+            for index in range(schedules_per_seed):
+                scenario = scens[index % len(scens)]
+                schedule = sample_schedule(rng, scenario, profs)
+                baseline = baseline_for(scenario)
+                outcome, detail = _run_schedule(
+                    scenario, schedule, baseline, budget_s, profs)
+                # harvest per-point coverage before reset wipes it
+                for point, _, _ in schedule:
+                    coverage[point]["armed"] += 1
+                    coverage[point]["fired"] += faults.fired(point)
+                for point in coverage:
+                    coverage[point]["hit"] += faults.hits(point)
+                faults.reset()
+                entry = {"seed": seed, "index": index,
+                         "scenario": scenario.name,
+                         "schedule": [list(a) for a in schedule],
+                         "outcome": outcome, "detail": detail}
+                runs.append(entry)
+                if outcome.startswith("violation"):
+                    violations.append(entry)
+    outcomes: Dict[str, int] = {}
+    for r in runs:
+        outcomes[r["outcome"]] = outcomes.get(r["outcome"], 0) + 1
+    return {
+        "seeds": list(seeds),
+        "schedules_per_seed": schedules_per_seed,
+        "budget_s": budget_s,
+        "scenarios": sorted({s.name for s in scens}),
+        "total_schedules": len(runs),
+        "outcomes": outcomes,
+        "violations": violations,
+        "points": coverage,
+        "elapsed_s": round(time.perf_counter() - t0, 3),
+    }

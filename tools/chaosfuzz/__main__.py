@@ -1,0 +1,66 @@
+"""CLI for the chaos-fuzz campaign.
+
+Examples::
+
+    python -m tools.chaosfuzz --seed 7
+    python -m tools.chaosfuzz --seed 1 --seed 2 --seed 3 \
+        --schedules 7 --budget 30 --report /tmp/chaosfuzz.json
+
+Exit status is 0 when every schedule upheld the invariants and 1 when
+any violation (hang, unattributed failure, fingerprint divergence) was
+recorded — the report's ``violations`` list has the details.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from mmlspark_tpu.core.env import CHAOSFUZZ_BUDGET_S, env_float
+
+from tools.chaosfuzz import run_campaign
+from tools.chaosfuzz.scenarios import all_scenarios
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.chaosfuzz",
+        description="seeded chaos-fuzz campaign over every registered "
+                    "fault point")
+    parser.add_argument("--seed", type=int, action="append",
+                        help="campaign seed; repeat for several "
+                             "independent campaigns (default: 1)")
+    parser.add_argument("--schedules", type=int, default=20,
+                        help="fault schedules per seed (default: 20)")
+    parser.add_argument("--budget", type=float, default=None,
+                        help="per-schedule watchdog budget in seconds "
+                             "(default: MMLSPARK_TPU_CHAOSFUZZ_BUDGET_S"
+                             ", 30)")
+    parser.add_argument("--scenario", action="append",
+                        choices=sorted(s.name for s in all_scenarios()),
+                        help="restrict to named scenarios (repeatable; "
+                             "default: all)")
+    parser.add_argument("--report", type=str, default=None,
+                        help="also write the JSON report to this path")
+    args = parser.parse_args(argv)
+
+    seeds = args.seed if args.seed else [1]
+    budget = (args.budget if args.budget is not None
+              else env_float(CHAOSFUZZ_BUDGET_S, 30.0, minimum=0.0))
+    report = run_campaign(seeds, args.schedules, budget,
+                          scenario_names=args.scenario)
+    text = json.dumps(report, indent=2, sort_keys=True)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    print(text)
+    n_viol = len(report["violations"])
+    print(f"chaosfuzz: {report['total_schedules']} schedules, "
+          f"{n_viol} violations, {report['elapsed_s']}s",
+          file=sys.stderr)
+    return 1 if n_viol else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,239 @@
+"""End-to-end scenarios the chaos-fuzz campaign drives under fault
+schedules.
+
+Each scenario is a small, deterministic workload that exercises one
+slice of the framework's fault surface (in-core fit with checkpoints,
+out-of-core fit over the spill plane, a streaming-refresh generation,
+a serving swap).  A scenario's ``run(work_dir, armed)`` returns a
+fingerprint dict; the campaign compares it against an unfaulted
+baseline.  Determinism is the whole game: the same seed and the same
+schedule must reproduce the same outcome, so every scenario fixes its
+data seed and relies on the trainer's pinned-parity env knobs (set by
+the campaign runner) for bitwise-stable models.
+
+The ``armed`` argument is the frozenset of fault-point names armed for
+this schedule — scenarios that swallow per-request errors (serving)
+use it to decide whether a failure is *attributed* to the injected
+fault or a genuine bug (which must surface as a violation).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request as urllib_request
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, Optional, Tuple
+
+import numpy as np
+
+
+class Unattributed(RuntimeError):
+    """A scenario observed a failure it could not pin on any armed
+    fault point — the campaign records this as a violation."""
+
+
+def _data(seed: int, n: int, f: int = 6, shift: float = 0.0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f)) + shift
+    y = x[:, 0] - 0.5 * x[:, 1] + 0.25 * x[:, 2] * x[:, 3] \
+        + rng.normal(size=n) * 0.1
+    return x, y
+
+
+def _estimator(**overrides):
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+    kw = dict(numIterations=5, numLeaves=7, maxBin=15, seed=0)
+    kw.update(overrides)
+    return LightGBMRegressor(**kw)
+
+
+_base_model_cache: Dict[str, object] = {}
+
+
+def _base_model():
+    """Module-cached generation-0 model shared by the refresh and
+    serving scenarios (fitting it is deterministic, so caching only
+    saves time, never changes a fingerprint)."""
+    if "model" not in _base_model_cache:
+        from mmlspark_tpu.core.dataframe import DataFrame
+        x, y = _data(0, 480)
+        _base_model_cache["model"] = _estimator().fit(
+            DataFrame({"features": x, "label": y}))
+    return _base_model_cache["model"]
+
+
+def _post(url, payload, timeout=30):
+    req = urllib_request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib_request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+def incore_fit(work_dir: str, armed: FrozenSet[str]) -> dict:
+    """Checkpointed in-core fit: boosting loop, level-histogram kernel,
+    native callback, checkpoint persistence.  A killed attempt resumes
+    from the newest verified segment checkpoint (same ``work_dir``)."""
+    from mmlspark_tpu.core.dataframe import DataFrame
+    x, y = _data(1, 384)
+    est = _estimator(checkpointDir=os.path.join(work_dir, "ckpt"),
+                     checkpointInterval=2)
+    model = est.fit(DataFrame({"features": x, "label": y}))
+    return {"model": model.get_model_string()}
+
+
+def ooc_fit(work_dir: str, armed: FrozenSet[str]) -> dict:
+    """Out-of-core fit over the spill plane.  Exercises framed spill
+    reads (verify + repair-from-source), chunk-store round-trips and
+    the DiskFull → in-core downgrade, which must stay bitwise-identical
+    under the campaign's pinned-parity knobs (q16 quantisation, EFB
+    off, fixed chunk rows)."""
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.core.env import env_override
+    x, y = _data(2, 2560)
+    with env_override("MMLSPARK_TPU_OOC", "on"), \
+            env_override("MMLSPARK_TPU_OOC_CHUNK_ROWS", "1024"):
+        model = _estimator(numIterations=4).fit(
+            DataFrame({"features": x, "label": y}))
+    return {"model": model.get_model_string()}
+
+
+def refresh(work_dir: str, armed: FrozenSet[str]) -> dict:
+    """One streaming-refresh generation: observe a fresh window, refit
+    warm-started segments, commit an integrity-stamped checkpoint.  A
+    killed attempt re-runs in the same ``work_dir`` and must resume to
+    the same committed model (segment checkpoints + pinned window
+    seed)."""
+    from mmlspark_tpu.io.refresh import RefreshController
+    ctrl = RefreshController(_estimator(), _base_model(),
+                             os.path.join(work_dir, "ckpt"),
+                             refresh_interval_s=10_000,
+                             min_refit_rows=32, segment_interval=2)
+    x, y = _data(3, 192, shift=0.5)
+    ctrl.observe(x, y)
+    result = ctrl.refresh(swap=False)
+    return {"model": result.model.get_model_string()}
+
+
+def serving(work_dir: str, armed: FrozenSet[str]) -> dict:
+    """Serve scores across a mid-stream hot-swap to a bitwise-identical
+    model.  Whether the swap commits or rolls back, every reply that
+    does come back must match the unfaulted baseline; failed requests
+    are tolerated only while a serving-plane fault is armed."""
+    from mmlspark_tpu.io.serving import ServingServer, SwapFailed
+    model = _base_model()
+    x, _ = _data(4, 8)
+    replies: Dict[str, float] = {}
+    with ServingServer(model, max_batch_size=4,
+                       max_latency_ms=2.0) as server:
+        for i in range(8):
+            if i == 4:
+                try:
+                    server.swap_model(server._default, model,
+                                      probe_payload={
+                                          "features": x[0].tolist()})
+                except SwapFailed:
+                    # rollback contract: the old (identical) model
+                    # keeps serving, replies stay bitwise
+                    pass
+                except Exception as e:
+                    if not _serving_attributed(e, armed):
+                        raise Unattributed(
+                            f"swap failed outside any armed fault: "
+                            f"{type(e).__name__}: {e}") from e
+            try:
+                r = _post(server.url,
+                          {"features": x[i % len(x)].tolist()},
+                          timeout=10)
+                replies[str(i)] = float(r["prediction"])
+            except Exception as e:
+                if not _serving_attributed(e, armed):
+                    raise Unattributed(
+                        f"request {i} failed outside any armed fault: "
+                        f"{type(e).__name__}: {e}") from e
+                if "serving.worker_kill" in armed:
+                    # the worker is gone for good; later requests can
+                    # only fail the same way
+                    break
+    return {"replies": replies}
+
+
+_SERVING_POINTS = ("serving.score", "serving.worker_kill",
+                   "registry.swap")
+
+
+def _serving_attributed(e: BaseException, armed: FrozenSet[str]) -> bool:
+    """Is this request/swap failure explained by an armed serving-plane
+    fault?  HTTP 5xx bodies are scanned for the injected-fault marker;
+    connection-level errors are accepted only while a fault that tears
+    down the worker or its replies is armed."""
+    text = f"{type(e).__name__}: {e}"
+    if isinstance(e, urllib.error.HTTPError):
+        try:
+            text += " " + e.read().decode("utf-8", "replace")
+        except Exception:
+            pass
+    if "injected fault" in text or "injected disk-full" in text:
+        return True
+    if any(p in text for p in armed):
+        return True
+    return any(p in armed for p in _SERVING_POINTS)
+
+
+def _compare_exact(baseline: dict, run: dict) -> Optional[str]:
+    if baseline != run:
+        return f"fingerprint diverged: baseline={baseline!r} run={run!r}"
+    return None
+
+
+def _compare_replies(baseline: dict, run: dict) -> Optional[str]:
+    """Subset comparator for serving: every reply the faulted run did
+    produce must be bitwise-equal to the baseline reply for the same
+    request index (missing replies were attributed failures)."""
+    base = baseline.get("replies", {})
+    for idx, score in run.get("replies", {}).items():
+        if idx not in base:
+            return f"reply for request {idx} absent from baseline"
+        if score != base[idx]:
+            return (f"reply {idx} diverged: baseline={base[idx]!r} "
+                    f"run={score!r}")
+    return None
+
+
+@dataclass(frozen=True)
+class Scenario:
+    name: str
+    run: Callable[[str, FrozenSet[str]], dict]
+    affinity: Tuple[str, ...]
+    resumable: bool = True
+    compare: Callable[[dict, dict], Optional[str]] = field(
+        default=_compare_exact)
+
+
+def all_scenarios() -> Tuple[Scenario, ...]:
+    """The campaign's scenario set, with each scenario's fault-point
+    affinity (the points its code path can actually reach — sampling
+    is biased toward these so armed faults usually fire)."""
+    return (
+        Scenario("incore_fit", incore_fit,
+                 ("gbdt.train_step", "gbdt.level_hist",
+                  "native.callback", "checkpoint.write", "io.disk_full",
+                  "train.participant_loss", "mesh.collective_hang",
+                  "allreduce")),
+        Scenario("ooc_fit", ooc_fit,
+                 ("spill.read", "io.disk_full", "gbdt.train_step",
+                  "gbdt.level_hist", "train.participant_loss")),
+        Scenario("refresh", refresh,
+                 ("refresh.fit", "stream.ingest", "checkpoint.write",
+                  "io.disk_full", "gbdt.train_step")),
+        Scenario("serving", serving,
+                 ("serving.score", "serving.worker_kill",
+                  "registry.swap"),
+                 resumable=False, compare=_compare_replies),
+    )
