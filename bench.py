@@ -516,6 +516,193 @@ def refresh_latency_main():
         ctrl.close()
 
 
+def refresh_under_load_main():
+    """``python bench.py --refresh-under-load``: the train-while-serve
+    row — serving p50/p99 during a co-located low-priority refit vs
+    idle at EQUAL offered load (the refit admission-control claim),
+    then a fleet-wide two-phase hot-swap under the same load with the
+    per-worker flip downtime and the rejected/timeout deltas across
+    the whole run. BENCH_REFRESH_ROWS / BENCH_REFRESH_TREES /
+    BENCH_SERVING_CLIENTS / BENCH_SERVING_DURATION_S override the
+    shape for rehearsals."""
+    platform = wait_for_backend(metric="refresh_under_load", unit="ms",
+                                allow_cpu_fallback=True)
+    print(f"# backend up: {platform}", file=sys.stderr, flush=True)
+    import tempfile
+    import threading
+    import urllib.request as urllib_request
+
+    import jax
+
+    from mmlspark_tpu.core.compile_cache import enable_persistent_cache
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.io.fleet import FleetSupervisor
+    from mmlspark_tpu.io.refresh import RefreshController
+    from mmlspark_tpu.io.serving import ServingFleet
+    from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+
+    enable_persistent_cache()
+    rng = np.random.default_rng(0)
+    n = int(os.environ.get("BENCH_REFRESH_ROWS", 50_000))
+    trees = int(os.environ.get("BENCH_REFRESH_TREES", 20))
+    clients = int(os.environ.get("BENCH_SERVING_CLIENTS", 8))
+    duration = float(os.environ.get("BENCH_SERVING_DURATION_S", 6))
+    f = 28
+
+    def window(shift):
+        x = (rng.normal(size=(n, f)) + shift).astype(np.float32)
+        y = x[:, 0] - 0.5 * x[:, 1] + 0.25 * x[:, 2] * x[:, 3]
+        return x, y
+
+    est = LightGBMRegressor(numIterations=trees, numLeaves=63,
+                            maxBin=63, minDataInLeaf=20, seed=0)
+    x0, y0 = window(0.0)
+    model = est.fit(DataFrame({"features": x0, "label": y0}))
+    payload = json.dumps({"features": x0[0].tolist()}).encode()
+
+    def healthz(server):
+        with urllib_request.urlopen(
+                f"http://{server.host}:{server.port}/healthz",
+                timeout=5) as r:
+            return json.loads(r.read())
+
+    def offered_load(servers, until):
+        """Closed-loop clients round-robined over the workers until
+        ``until()`` flips; returns (latencies_ms, client_errors)."""
+        lat, errors = [], [0]
+        stop = threading.Event()
+
+        def client(i):
+            url = servers[i % len(servers)].url
+            while not stop.is_set():
+                t = time.perf_counter()
+                try:
+                    req = urllib_request.Request(
+                        url, data=payload,
+                        headers={"Content-Type": "application/json"})
+                    with urllib_request.urlopen(req, timeout=10) as r:
+                        r.read()
+                    lat.append((time.perf_counter() - t) * 1e3)
+                except Exception:
+                    errors[0] += 1
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True) for i in range(clients)]
+        for t in threads:
+            t.start()
+        while not until():
+            time.sleep(0.02)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        return np.asarray(lat, dtype=np.float64), errors[0]
+
+    def pctls(lat):
+        if not len(lat):
+            return 0.0, 0.0
+        return (float(np.percentile(lat, 50)),
+                float(np.percentile(lat, 99)))
+
+    with tempfile.TemporaryDirectory() as td:
+        fleet = ServingFleet(model, num_servers=2, max_batch_size=64,
+                             max_latency_ms=2.0).start()
+        sup = FleetSupervisor(fleet, min_workers=2, max_workers=2)
+        servers = list(fleet.servers)
+        name = servers[0]._default
+        ctrl = RefreshController(est, model, td, server=servers[0],
+                                 priority="low",
+                                 refresh_interval_s=10_000,
+                                 min_refit_rows=n)
+        before = [healthz(s) for s in servers]
+        try:
+            # -- phase 1: idle baseline at the offered load ----------
+            t_end = time.perf_counter() + duration
+            idle_lat, idle_err = offered_load(
+                servers, lambda: time.perf_counter() >= t_end)
+            p50_idle, p99_idle = pctls(idle_lat)
+            # -- phase 2: same load while the refit runs co-located --
+            ctrl.observe(*window(0.5))
+            refit_done = threading.Event()
+            refit_box = {}
+
+            def refit():
+                try:
+                    refit_box["result"] = ctrl.refresh(swap=False)
+                finally:
+                    refit_done.set()
+
+            rt = threading.Thread(target=refit, daemon=True)
+            rt.start()
+            refit_lat, refit_err = offered_load(
+                servers, refit_done.is_set)
+            rt.join(timeout=600)
+            result = refit_box["result"]
+            p50_refit, p99_refit = pctls(refit_lat)
+            # -- phase 3: fleet-wide swap under the same load --------
+            swap_done = threading.Event()
+            swap_box = {}
+
+            def swap():
+                try:
+                    swap_box["result"] = sup.swap_model_fleet(
+                        name, result.model,
+                        probe_payload={"features": x0[0].tolist()})
+                finally:
+                    swap_done.set()
+
+            st = threading.Thread(target=swap, daemon=True)
+            st.start()
+            _, swap_err = offered_load(servers, swap_done.is_set)
+            st.join(timeout=600)
+            swap_result = swap_box["result"]
+            after = [healthz(s) for s in servers]
+        finally:
+            ctrl.close()
+            fleet.stop()
+
+    on_cpu = (platform == "cpu-fallback"
+              or jax.default_backend() == "cpu")
+    intended_cpu = os.environ.get("BENCH_PLATFORM") == "cpu"
+    suffix = "_cpu_fallback" if on_cpu and not intended_cpu else ""
+    if n != 50_000 or trees != 20:
+        suffix += f"_rows{n}_trees{trees}"
+    print(json.dumps({
+        "metric": "refresh_under_load" + suffix,
+        "value": round(p99_refit, 3),
+        "unit": "ms",
+        "vs_baseline": None,  # no measured external comparator yet
+        "backend": jax.default_backend(),
+        "backend_preflight": PREFLIGHT["verdict"],
+        "rows": n,
+        "new_trees": trees,
+        "clients": clients,
+        "priority": "low",
+        "p50_idle_ms": round(p50_idle, 3),
+        "p99_idle_ms": round(p99_idle, 3),
+        "p50_refit_ms": round(p50_refit, 3),
+        "p99_refit_ms": round(p99_refit, 3),
+        "p99_refit_over_idle": round(p99_refit / p99_idle, 3)
+        if p99_idle else None,
+        "requests_idle": int(len(idle_lat)),
+        "requests_refit": int(len(refit_lat)),
+        "client_errors": idle_err + refit_err + swap_err,
+        "refit_s": round(result.refit_s, 3),
+        "refit_yields": ctrl.stats["refit_yields"],
+        "refit_yield_s": round(ctrl.stats["refit_yield_s"], 3),
+        "fleet_swap_s": round(swap_result["swap_s"], 4),
+        "per_worker_downtime_ms": {
+            wk: round(t["downtime_s"] * 1e3, 3)
+            for wk, t in swap_result["per_worker"].items()},
+        "rejected_503_delta": sum(h["rejected"] for h in after)
+        - sum(h["rejected"] for h in before),
+        "timeout_504_delta": sum(h["timeouts"] for h in after)
+        - sum(h["timeouts"] for h in before),
+        "train_stalls": _resilience_counters()[0],
+        "train_recoveries": _resilience_counters()[1],
+        "peak_rss_mb": peak_rss_mb(),
+    }))
+
+
 def preflight_main():
     """``python bench.py --preflight``: attribute real-backend
     bring-up WITHOUT running a workload (ROADMAP item 2a, first
@@ -603,6 +790,8 @@ if __name__ == "__main__":
         serving_elastic_main()
     elif "--serving-sustained" in sys.argv:
         serving_sustained_main()
+    elif "--refresh-under-load" in sys.argv:
+        refresh_under_load_main()
     elif "--refresh-latency" in sys.argv:
         refresh_latency_main()
     elif "--ooc" in sys.argv:

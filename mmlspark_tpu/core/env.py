@@ -193,6 +193,19 @@ REFRESH_INTERVAL_S = register(
     "streaming refresh loop: seconds between time-based refit checks "
     "(a refit arms when the interval elapsed and the buffer holds "
     "enough rows; detected drift arms one sooner)")
+REFRESH_PRIORITY = register(
+    "MMLSPARK_TPU_REFRESH_PRIORITY", "str", "low",
+    "co-located refresh loop priority (io/refresh.py): 'low' installs "
+    "the train-step throttle for the refit, which yields whenever the "
+    "bound server's serving queue crosses its high-water mark (a "
+    "background refit cannot starve the data plane); 'high' refits at "
+    "full speed")
+REFRESH_YIELD_S = register(
+    "MMLSPARK_TPU_REFRESH_YIELD_S", "float", 2.0,
+    "max seconds a low-priority refit yields at any one train-step "
+    "boundary while the co-located serving queue stays past high "
+    "water; the refit then takes its step anyway (forward progress "
+    "beats perfect politeness)")
 DRIFT_THRESHOLD = register(
     "MMLSPARK_TPU_DRIFT_THRESHOLD", "float", 0.2,
     "drift-detector arm level for the max per-feature statistic "

@@ -78,6 +78,18 @@ KNOWN_POINTS: Dict[str, str] = {
                      "(ServingServer.swap_model) — a corrupted or "
                      "crashed swap that must roll back to the old "
                      "model",
+    "registry.swap_fanout": "fleet-wide two-phase swap fan-out "
+                            "(FleetSupervisor.swap_model_fleet), once "
+                            "per worker prepare — a worker that dies "
+                            "mid-fan-out; every already-prepared "
+                            "worker must roll back and the old model "
+                            "keeps serving fleet-wide",
+    "serving.observe_log": "serving request-log tap "
+                           "(ServingServer._notify_taps) — a dying or "
+                           "stalling observer; the data plane must "
+                           "keep replying and the refresh loop later "
+                           "replays the dropped rows from the durable "
+                           "request log",
     "fleet.spawn": "ServingFleet worker construction "
                    "(ServingFleet._make_server) — a worker that fails "
                    "to come up; the supervisor's restart path must "

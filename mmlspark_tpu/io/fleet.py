@@ -53,7 +53,7 @@ from mmlspark_tpu.core.env import (
 from mmlspark_tpu.core.faults import fault_point
 from mmlspark_tpu.core.logging_utils import logger
 from mmlspark_tpu.core.retries import RetryPolicy, with_retries
-from mmlspark_tpu.io.serving import ServingFleet, ServingServer
+from mmlspark_tpu.io.serving import ServingFleet, ServingServer, SwapFailed
 
 __all__ = ["FleetSupervisor"]
 
@@ -122,7 +122,8 @@ class FleetSupervisor:
         self._last_scale_t = 0.0
         self._stats = {"heartbeats": 0, "deaths": 0, "spawns": 0,
                        "scale_ups": 0, "scale_downs": 0, "drained": 0,
-                       "spawn_failures": 0}
+                       "spawn_failures": 0, "fleet_swaps": 0,
+                       "fleet_swap_rollbacks": 0}
         # (t_monotonic, n_workers) after every pass — the worker-count
         # trajectory the serving_elastic bench row reports
         self.history: List[Tuple[float, int]] = []
@@ -215,6 +216,83 @@ class FleetSupervisor:
                 "stopping with pendings flushed as errors",
                 victim.host, victim.port, self.drain_timeout_s)
         victim.stop()
+
+    # -- fleet-wide hot-swap -------------------------------------------------
+    def swap_model_fleet(self, name: str, model,
+                         probe_payload: Optional[Dict[str, Any]] = None
+                         ) -> Dict[str, Any]:
+        """Atomically hot-swap served model ``name`` to ``model`` on
+        EVERY worker of the fleet — the fleet-wide consistent update of
+        arXiv:1605.08695 §4.2, as a two-phase commit over the
+        per-server swap machinery:
+
+          1. **prepare** — each worker builds, warms and probes the new
+             plane via :meth:`ServingServer.prepare_swap` WITHOUT
+             flipping its registry; the old model keeps serving every
+             request on every worker for the whole phase (``/healthz``
+             walks ``ok -> degraded(swap-in-progress)`` per worker, so
+             :class:`~mmlspark_tpu.io.serving.FleetClient` routes
+             around mid-swap workers exactly as for a local swap);
+          2. **commit** — only when every prepare succeeded, each
+             worker flips its pointer (:meth:`ServingServer.\\
+commit_swap`); the per-worker downtime is the flip alone, the plane
+             compile already happened cold;
+          3. **rollback** — ANY prepare failure aborts every
+             already-prepared worker (:meth:`ServingServer.\\
+abort_swap`; nothing was flipped, so the old model never stopped
+             serving anywhere) and raises an attributed
+             :class:`SwapFailed` naming the failing worker.
+
+        Chaos boundary ``registry.swap_fanout`` fires once per worker
+        prepare. Membership is snapshotted under the fleet lock at
+        entry: workers spawned mid-swap serve the old model until the
+        next swap (supervise accordingly — typically call this from
+        the same thread that ticks the supervisor). Returns
+        ``{"model", "workers", "swap_s", "per_worker": {"host:port":
+        {"swap_s", "downtime_s"}}}``."""
+        with self.fleet._servers_lock:
+            servers = list(self.fleet.servers)
+        if not servers:
+            raise SwapFailed(
+                f"fleet-wide swap of {name!r}: the fleet has no "
+                "workers to swap")
+        t0 = time.monotonic()
+        prepared: List[Tuple[ServingServer, Any]] = []
+        try:
+            for server in servers:
+                # chaos boundary: a worker that dies mid-fan-out —
+                # every already-prepared sibling must roll back
+                fault_point("registry.swap_fanout")
+                prepared.append(
+                    (server,
+                     server.prepare_swap(name, model,
+                                         probe_payload=probe_payload)))
+        except Exception as e:
+            failing = servers[len(prepared)]
+            for server, handle in prepared:
+                try:
+                    server.abort_swap(handle)
+                except Exception:  # rollback is best-effort per worker
+                    logger.exception(
+                        "fleet swap rollback failed on %s:%s",
+                        server.host, server.port)
+            self._stats["fleet_swap_rollbacks"] += 1
+            raise SwapFailed(
+                f"fleet-wide swap of {name!r} rolled back: worker "
+                f"{failing.host}:{failing.port} failed prepare "
+                f"({type(e).__name__}: {e}); the old model keeps "
+                f"serving on all {len(servers)} workers") from e
+        per_worker: Dict[str, Dict[str, Any]] = {}
+        for server, handle in prepared:
+            per_worker[f"{server.host}:{server.port}"] = \
+                server.commit_swap(handle)
+        self._stats["fleet_swaps"] += 1
+        logger.info(
+            "fleet-wide swap of %r committed on %d workers in %.3fs",
+            name, len(servers), time.monotonic() - t0)
+        return {"model": name, "workers": len(servers),
+                "swap_s": time.monotonic() - t0,
+                "per_worker": per_worker}
 
     # -- policy --------------------------------------------------------------
     def _decide(self, healths: List[Dict[str, Any]]) -> None:

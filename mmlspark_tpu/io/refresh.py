@@ -56,16 +56,18 @@ from typing import Any, Dict, Iterable, Optional, Tuple
 import numpy as np
 
 from mmlspark_tpu.core.dataframe import DataFrame
-from mmlspark_tpu.core.env import (REFRESH_INTERVAL_S, STREAM_BUFFER,
-                                   env_int)
+from mmlspark_tpu.core.env import (REFRESH_INTERVAL_S, REFRESH_PRIORITY,
+                                   REFRESH_YIELD_S, STREAM_BUFFER,
+                                   env_float, env_int, env_str)
 from mmlspark_tpu.core.faults import fault_point
-from mmlspark_tpu.core.logging_utils import logger
+from mmlspark_tpu.core.logging_utils import logger, warn_once
 from mmlspark_tpu.core.serialize import (dir_digest,
                                          load_latest_checkpoint,
                                          load_stage, save_checkpoint,
                                          save_stage)
 from mmlspark_tpu.exploratory.drift import DriftDetector, DriftReport
 from mmlspark_tpu.io.serving import ServingServer, SwapFailed
+from mmlspark_tpu.parallel import resilience
 from mmlspark_tpu.parallel.prefetch import BatchPrefetcher
 
 __all__ = ["StreamBuffer", "RefreshController", "RefreshResult"]
@@ -157,6 +159,50 @@ class StreamBuffer:
             self._lock.notify_all()
 
 
+class _RefitYield:
+    """Refit admission control: installed as the resilience step
+    throttle (:func:`~mmlspark_tpu.parallel.resilience.\\
+install_step_throttle`) for the duration of a low-priority refit
+    co-located with live serving. At every train-step boundary it
+    snapshots the bound server's total queue depth (lock-free read — an
+    approximate depth is fine for a throttle) and, while the queue sits
+    at or past the server's priority high-water mark, sleeps in short
+    slices until the data plane drains or the per-step yield budget
+    (``MMLSPARK_TPU_REFRESH_YIELD_S``) is spent: the refit hands the
+    core to the scoring thread instead of racing it for the GIL and
+    device, which is what "a background refit cannot starve the data
+    plane" means mechanically. The yield runs *before* any watchdog
+    span opens, so politeness never reads as a stall."""
+
+    def __init__(self, server: ServingServer,
+                 max_yield_s: Optional[float] = None,
+                 poll_s: float = 0.005):
+        self.server = server
+        if max_yield_s is None:
+            max_yield_s = env_float(REFRESH_YIELD_S, 2.0, minimum=0.0)
+        self.max_yield_s = float(max_yield_s)
+        self.poll_s = poll_s
+        self.yields = 0
+        self.yield_s = 0.0
+
+    def _depth(self) -> int:
+        try:
+            return sum(len(m.queue)
+                       for m in list(self.server._models.values()))
+        except RuntimeError:
+            return 0  # registry resized mid-iteration; skip this read
+
+    def __call__(self, tag: Any = None) -> None:
+        if self._depth() < self.server.queue_high_water:
+            return
+        self.yields += 1
+        t0 = time.monotonic()
+        while (time.monotonic() - t0 < self.max_yield_s
+               and self._depth() >= self.server.queue_high_water):
+            time.sleep(self.poll_s)
+        self.yield_s += time.monotonic() - t0
+
+
 @dataclass
 class RefreshResult:
     """One committed :meth:`RefreshController.refresh` cycle."""
@@ -203,7 +249,8 @@ class RefreshController:
                  refresh_interval_s: Optional[float] = None,
                  min_refit_rows: int = 256,
                  segment_interval: int = 1,
-                 reference_rows: Optional[np.ndarray] = None):
+                 reference_rows: Optional[np.ndarray] = None,
+                 priority: Optional[str] = None):
         self.estimator = estimator
         self.checkpoint_dir = checkpoint_dir
         self.server = server
@@ -219,13 +266,29 @@ class RefreshController:
         self.segment_interval = int(segment_interval)
         self.model = model
         self.generation = 0
+        # refit admission control: at "low" (the default), a refit
+        # sharing a process with self.server installs the train-step
+        # throttle so serving queue pressure pauses the refit, never
+        # the other way around
+        if priority is None:
+            priority = env_str(REFRESH_PRIORITY, "low") or "low"
+        priority = priority.strip().lower()
+        if priority not in ("low", "high"):
+            warn_once("refresh.priority",
+                      "%s=%r is not low|high; using low",
+                      REFRESH_PRIORITY, priority)
+            priority = "low"
+        self.priority = priority
         # drained-but-uncommitted window: survives a killed refit so
         # the retry trains on the same rows (determinism contract)
         self._pending: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self._last_refresh = time.monotonic()
         self.stats = {"refreshes": 0, "refresh_failures": 0,
                       "swaps": 0, "swap_failures": 0,
-                      "drift_arms": 0, "interval_arms": 0}
+                      "drift_arms": 0, "interval_arms": 0,
+                      "tap_rows": 0, "tap_dropped": 0,
+                      "refit_yields": 0, "refit_yield_s": 0.0,
+                      "leaked_thread": None}
         if reference_rows is not None:
             self.detector.set_reference(reference_rows)
         # crash recovery: the newest committed generation on disk wins
@@ -292,15 +355,79 @@ class RefreshController:
         overlap of parallel/prefetch.py applied to ingestion: the
         stream source runs ahead on its own thread, bounded by
         ``depth`` staged blocks plus the buffer's row capacity).
-        Returns rows ingested; the producer thread is always joined
-        on exit, exceptions included."""
+        Returns rows ingested; the producer thread is always joined on
+        exit, exceptions included, with the prefetcher's 10s join
+        budget — a producer wedged past it is surfaced warn-once by
+        the prefetcher and recorded in ``stats["leaked_thread"]``
+        instead of silently dropped."""
         rows = 0
-        with BatchPrefetcher(stream, depth=depth,
-                             label="refresh-ingest") as staged:
-            for x, y in staged:
-                self.observe(x, y)
-                rows += len(np.atleast_2d(x))
+        prefetcher = BatchPrefetcher(stream, depth=depth,
+                                     label="refresh-ingest")
+        try:
+            with prefetcher as staged:
+                for x, y in staged:
+                    self.observe(x, y)
+                    rows += len(np.atleast_2d(x))
+        finally:
+            # the close already happened (with-exit runs even when an
+            # armed stream.ingest fault raises out of observe); what
+            # remains is surfacing its leak verdict
+            self.stats["leaked_thread"] = \
+                prefetcher.stats().get("leaked_thread")
         return rows
+
+    def tap_serving(self, server: Optional[ServingServer] = None,
+                    label_fn: Optional[Any] = None,
+                    model_name: Optional[str] = None):
+        """Close the loop: feed this controller's refit window from a
+        server's own scored traffic. Registers a request-log tap
+        (:meth:`ServingServer.observe_log`) that converts every scored
+        batch into labeled rows — features straight from each request
+        payload's ``featuresCol`` field, label from
+        ``label_fn(payload, reply_row)`` (default: the served
+        ``prediction``, i.e. self-training pseudo-labels; pass a real
+        labeler when ground truth travels with the request).
+
+        The tap NEVER blocks the data plane: rows are offered to the
+        buffer with a zero timeout and *dropped* under backpressure
+        (counted in ``stats["tap_dropped"]``; delivered rows in
+        ``stats["tap_rows"]``) — the durable request log, not this
+        best-effort tap, is the source of truth for replaying a refit
+        window. Returns the registered tap callable."""
+        server = server if server is not None else self.server
+        if server is None:
+            raise ValueError(
+                "tap_serving() needs a server: pass one or construct "
+                "the controller with server=")
+        features_col = self.estimator.get("featuresCol")
+
+        def _tap(name: str, payloads, cols) -> None:
+            rows, labels = [], []
+            for i, payload in enumerate(payloads):
+                feats = payload.get(features_col)
+                if feats is None:
+                    continue
+                reply_row = {c: cols[c][i] for c in cols}
+                if label_fn is not None:
+                    label = label_fn(payload, reply_row)
+                else:
+                    col = ("prediction" if "prediction" in reply_row
+                           else next(iter(reply_row)))
+                    label = reply_row[col]
+                if label is None:
+                    continue  # labeler abstained; not a window row
+                rows.append(np.asarray(feats, dtype=np.float64).ravel())
+                labels.append(float(np.asarray(label).ravel()[0]))
+            if not rows:
+                return
+            if self.observe(np.stack(rows), np.asarray(labels),
+                            timeout=0.0):
+                self.stats["tap_rows"] += len(rows)
+            else:
+                self.stats["tap_dropped"] += len(rows)
+
+        server.observe_log(_tap, model_name=model_name)
+        return _tap
 
     # -- refresh decision ----------------------------------------------------
     def poll(self) -> Tuple[Optional[str], DriftReport]:
@@ -359,6 +486,14 @@ class RefreshController:
         gen = self.generation + 1
         seg_dir = os.path.join(self.checkpoint_dir,
                                f"gen_{gen:08d}_segments")
+        # admission control: a low-priority refit co-located with live
+        # serving yields at train-step boundaries while the serving
+        # queue sits past high water (restored even on a killed refit)
+        throttle: Optional[_RefitYield] = None
+        prev_throttle = None
+        if self.server is not None and self.priority == "low":
+            throttle = _RefitYield(self.server)
+            prev_throttle = resilience.install_step_throttle(throttle)
         try:
             # chaos boundary: the refit killed at entry (raise) or fed
             # a mangled window (corrupt) — retried refits must resume
@@ -374,6 +509,11 @@ class RefreshController:
         except Exception:
             self.stats["refresh_failures"] += 1
             raise
+        finally:
+            if throttle is not None:
+                resilience.install_step_throttle(prev_throttle)
+                self.stats["refit_yields"] += throttle.yields
+                self.stats["refit_yield_s"] += throttle.yield_s
         refit_s = time.monotonic() - t0
         # generation commit: stage dir first, crash-safe manifest last
         # (the save_checkpoint manifest is the commit point — a kill

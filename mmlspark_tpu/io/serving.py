@@ -81,7 +81,7 @@ import time
 import urllib.parse
 from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -326,6 +326,20 @@ class SwapFailed(RuntimeError):
     keeps) serving every request."""
 
 
+class _PreparedSwap:
+    """Handle for phase 1 of a two-phase hot-swap: the new plane is
+    built, warmed and probed but the registry pointer has NOT flipped —
+    pass to :meth:`ServingServer.commit_swap` or
+    :meth:`ServingServer.abort_swap` (exactly one of them)."""
+
+    __slots__ = ("name", "new", "t0")
+
+    def __init__(self, name: str, new: "_ServedModel", t0: float):
+        self.name = name
+        self.new = new
+        self.t0 = t0
+
+
 class _ServedModel:
     """One registered model: its bounded queue, stats, and (while warm)
     compiled binned plane."""
@@ -436,12 +450,16 @@ class ServingServer:
         self._stats = {"served": 0, "errors": 0, "rejected": 0,
                        "timeouts": 0, "swaps": 0, "swap_rollbacks": 0,
                        "admitted": 0, "shed_tenant": 0,
-                       "shed_priority": 0}
+                       "shed_priority": 0, "log_rows": 0,
+                       "log_tap_errors": 0}
         self._last_shed = 0.0  # monotonic time of the last 503
         self._last_binned_fallback = 0.0
         # model-name -> degradation reason while a hot-swap is running
         # (/healthz flips degraded with this reason for the duration)
         self._swapping: Dict[str, str] = {}
+        # request-log taps: (model_name filter, callable) observers of
+        # every scored batch — the refresh loop's ingest source
+        self._log_taps: List[Tuple[Optional[str], Callable]] = []
 
         server = self
 
@@ -996,6 +1014,146 @@ class ServingServer:
         return {"model": name, "swap_s": now - t0,
                 "downtime_s": now - (t_flip if t_flip else now)}
 
+    # -- two-phase hot-swap (fleet-wide fan-out building blocks) -------------
+    def prepare_swap(self, name: str, model: Transformer,
+                     probe_payload: Optional[Dict[str, Any]] = None
+                     ) -> "_PreparedSwap":
+        """Phase 1 of a fleet-wide swap (:meth:`FleetSupervisor.\\
+swap_model_fleet`): build + pre-warm the new compiled plane and score
+        its verification batch WITHOUT flipping the registry — unlike
+        :meth:`swap_model`, the old model keeps serving every request
+        right through the probe, so a prepare that fails on any worker
+        of a fleet leaves nothing to undo anywhere. ``/healthz``
+        reports ``degraded(swap-in-progress)`` until
+        :meth:`commit_swap` or :meth:`abort_swap` closes the window.
+        Shares the single-server machinery: ``_ensure_plane``, the
+        ``registry.swap`` chaos boundary, and ``_probe``. Raises
+        :class:`SwapFailed` (window cleared, rollback counted) on any
+        failure."""
+        with self._lock:
+            if name not in self._models:
+                raise KeyError(
+                    f"prepare_swap: {name!r} is not a served model "
+                    f"(have {sorted(self._models)}); the swap API "
+                    "replaces models, it does not add them")
+            old = self._models[name]
+            if self._swapping.get(name):
+                raise SwapFailed(
+                    f"a swap of {name!r} is already in progress")
+            self._swapping[name] = "swap-in-progress"
+        t0 = time.monotonic()
+        new = _ServedModel(name, model, old.max_queue,
+                           self._consumes_id_column(model))
+        new.binned_mode = old.binned_mode
+        new.held = True
+        try:
+            self._ensure_plane(new)
+            # same chaos boundary as the single-server swap: the
+            # fan-out must roll back every worker when any prepare dies
+            new = fault_point("registry.swap", new)
+            self._probe(new, probe_payload)
+        except Exception as e:
+            with self._lock:
+                self._swapping.pop(name, None)
+                self._stats["swap_rollbacks"] += 1
+                old.stats["swap_rollbacks"] += 1
+                self._lock.notify_all()
+            raise SwapFailed(
+                f"prepared swap of model {name!r} failed and was "
+                f"rolled back; the previous model keeps serving "
+                f"({type(e).__name__}: {e})") from e
+        return _PreparedSwap(name=name, new=new, t0=t0)
+
+    def commit_swap(self, prepared: "_PreparedSwap") -> Dict[str, Any]:
+        """Phase 2: flip the registry pointer to an already-probed
+        plane. The flip itself is the entire per-worker downtime
+        window — pending requests migrate to the new model's queue
+        (their pre-binned rows dropped, the new binning owns them) and
+        are immediately scoreable, no probation hold. Returns
+        ``{"model", "swap_s", "downtime_s"}``."""
+        name, new = prepared.name, prepared.new
+        t_flip = time.monotonic()
+        with self._lock:
+            old = self._models[name]
+            # serving-continuity: copy the counters at flip time (not
+            # prepare time — the old model kept serving through the
+            # probe and any sibling workers' prepares)
+            new.stats = dict(old.stats)
+            new.queue = old.queue
+            old.queue = []
+            for p in new.queue:
+                p.binned = None  # old-plane bin ids are invalid
+            new.held = False
+            new.stats["swaps"] += 1
+            self._models[name] = new
+            if self.model is old.model:
+                self.model = new.model
+            self._swapping.pop(name, None)
+            self._stats["swaps"] += 1
+            self._lock.notify_all()
+        old.plane = None
+        booster = getattr(old.model, "booster", None)
+        if booster is not None and hasattr(booster, "clear_jit_cache"):
+            booster.clear_jit_cache()
+        now = time.monotonic()
+        return {"model": name, "swap_s": now - prepared.t0,
+                "downtime_s": now - t_flip}
+
+    def abort_swap(self, prepared: "_PreparedSwap") -> None:
+        """Roll back a prepared (never flipped) swap: the old model
+        never stopped serving, so this only closes the degraded window,
+        counts the rollback, and lets the built plane be collected."""
+        with self._lock:
+            old = self._models.get(prepared.name)
+            self._swapping.pop(prepared.name, None)
+            self._stats["swap_rollbacks"] += 1
+            if old is not None:
+                old.stats["swap_rollbacks"] += 1
+            self._lock.notify_all()
+
+    # -- request-log tap -----------------------------------------------------
+    def observe_log(self, tap: Callable[..., None],
+                    model_name: Optional[str] = None) -> None:
+        """Register a bounded request-log tap: after every scored batch
+        the scoring thread calls ``tap(model_name, payloads, cols)``
+        with the batch's (id-stripped) payload dicts and reply columns
+        — the ingest source for a co-located
+        :class:`~mmlspark_tpu.io.refresh.RefreshController` (its
+        ``tap_serving``). ``model_name`` filters to one registry entry
+        (None = every model). Taps MUST NOT block (offer with a zero
+        timeout and drop under backpressure — the tap runs on the one
+        scoring thread, which IS the data plane) and a raising tap is
+        absorbed (warn-once + ``log_tap_errors`` counter): observation
+        must never take a reply down. Chaos boundary:
+        ``serving.observe_log``."""
+        with self._lock:
+            self._log_taps.append((model_name, tap))
+
+    def _notify_taps(self, served: _ServedModel,
+                     batch: List[_Pending], cols: Dict[str, Any]) -> None:
+        with self._lock:
+            taps = [t for mn, t in self._log_taps
+                    if mn is None or mn == served.name]
+        if not taps:
+            return
+        payloads = [p.payload for p in batch]
+        for tap in taps:
+            try:
+                # chaos boundary: a dying observer — the replies above
+                # already went out; the refresh loop must later replay
+                # the dropped rows from the durable request log
+                fault_point("serving.observe_log")
+                tap(served.name, payloads, cols)
+                with self._lock:
+                    self._stats["log_rows"] += len(batch)
+            except Exception as e:
+                warn_once("serving.observe_log",
+                          "request-log tap failed (%s); serving "
+                          "continues — dropped rows must be replayed "
+                          "from the durable request log", e)
+                with self._lock:
+                    self._stats["log_tap_errors"] += 1
+
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ServingServer":
         self._warm_start()
@@ -1060,19 +1218,38 @@ FleetSupervisor` notices via missed heartbeats and respawns."""
         ``503 + Retry-After``; deregister from the fleet first so
         clients stop picking this worker), then wait until every
         already-accepted request has been scored and replied — queues
-        empty AND no batch in flight. Returns True when fully drained,
-        False on timeout (pendings may remain). Call :meth:`stop`
-        afterwards; the drain guarantee is that scale-down loses zero
-        accepted requests."""
+        empty AND no batch in flight AND no hot-swap holding a queue.
+        Returns True when fully drained, False on timeout (pendings may
+        remain). Call :meth:`stop` afterwards; the drain guarantee is
+        that scale-down loses zero accepted requests.
+
+        Swap interplay: a swap in flight holds the migrated queue out
+        of the batch loop until its verification batch resolves — those
+        are *accepted* requests, so an expiring deadline must not
+        abandon them to :meth:`stop`'s error flush. Drain outlives the
+        swap window (commit and rollback both release the queue and
+        notify), then restarts its budget once so the released requests
+        actually get scored."""
         self._draining = True
         deadline = time.monotonic() + timeout_s
+        extended = False
         with self._lock:
             while True:
                 depth = sum(len(m.queue) for m in self._models.values())
-                if depth == 0 and self._inflight_batches == 0:
+                swapping = bool(self._swapping)
+                if (depth == 0 and self._inflight_batches == 0
+                        and not swapping):
                     return True
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
+                    if swapping:
+                        extended = True
+                        self._lock.wait(timeout=0.1)
+                        continue
+                    if extended:
+                        extended = False
+                        deadline = time.monotonic() + timeout_s
+                        continue
                     return False
                 self._lock.wait(timeout=min(remaining, 0.1))
 
@@ -1235,6 +1412,10 @@ FleetSupervisor` notices via missed heartbeats and respawns."""
             # /healthz percentiles (deque append is atomic; no lock)
             served.latencies.append((t_done, (t_done - p.t0) * 1e3))
             p.event.set()
+        # observation happens after every reply went out: a slow or
+        # dying tap adds zero client-visible latency to this batch
+        if self._log_taps:
+            self._notify_taps(served, batch, cols)
 
 
 class ContinuousServingServer(ServingServer):

@@ -67,8 +67,9 @@ from mmlspark_tpu.core.logging_utils import logger
 __all__ = [
     "TrainStalled", "ParticipantLost", "TrainWatchdog", "FitRecovery",
     "ResilientFitResult", "fit_watchdog", "stall_guard", "fit_resilient",
-    "step_start", "step_end", "mark_boundary", "restore_boundary",
-    "boundary", "stall_count", "recovery_count", "reset",
+    "step_start", "step_end", "install_step_throttle", "mark_boundary",
+    "restore_boundary", "boundary", "stall_count", "recovery_count",
+    "reset",
 ]
 
 
@@ -107,13 +108,33 @@ class _WatchdogInterrupt(BaseException):
 # ---------------------------------------------------------------------------
 
 _active: Optional["TrainWatchdog"] = None
+_step_throttle: Optional[Callable[[Any], None]] = None
 _lock = threading.Lock()
 _stall_count = 0
 _recovery_count = 0
 
 
+def install_step_throttle(fn: Optional[Callable[[Any], None]]
+                          ) -> Optional[Callable[[Any], None]]:
+    """Install (``None`` clears) a callable invoked at every train-step
+    boundary, before any watchdog span opens — the refit
+    admission-control hook (io/refresh.py): a low-priority refit
+    co-located with live serving yields here while the serving queue
+    sits past its high-water mark.  Running before ``_span_start``
+    means the yield never counts against the stall budget.  Returns the
+    previous throttle so callers can restore it; the disabled fast path
+    stays a single extra ``is None`` check.
+    """
+    global _step_throttle
+    prev = _step_throttle
+    _step_throttle = fn
+    return prev
+
+
 def step_start(tag: Any = None) -> None:
     """Open a host span at a train-step boundary. Free when disabled."""
+    if _step_throttle is not None:
+        _step_throttle(tag)
     if _active is None:
         return
     _active._span_start(tag)
@@ -177,9 +198,11 @@ def recovery_count() -> int:
 
 
 def reset() -> None:
-    """Test hook: clear counters and any leaked active watchdog."""
-    global _active, _stall_count, _recovery_count
+    """Test hook: clear counters, any leaked active watchdog, and any
+    leaked step throttle."""
+    global _active, _step_throttle, _stall_count, _recovery_count
     _active = None
+    _step_throttle = None
     _stall_count = 0
     _recovery_count = 0
 

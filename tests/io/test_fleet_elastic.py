@@ -203,6 +203,88 @@ def test_drain_loses_zero_accepted_requests():
         fleet.stop()
 
 
+class _SlowFirstScore(Transformer):
+    """Factor-scaling model whose FIRST transform (the swap's
+    verification probe) sleeps — stretches the hot-swap's held
+    probation window so a drain deadline deterministically expires
+    inside it."""
+
+    def __init__(self, factor, first_delay_s):
+        super().__init__()
+        self.factor = factor
+        self.first_delay_s = first_delay_s
+        self._calls = 0
+
+    def _transform(self, df):
+        self._calls += 1
+        if self._calls == 1:
+            time.sleep(self.first_delay_s)
+        return df.with_column(
+            "scaled", np.asarray(df.col("x"), np.float64) * self.factor)
+
+
+def test_drain_flushes_swap_holding_queue():
+    """Regression (PR 17): requests accepted while an in-flight
+    hot-swap holds the queue in probation must survive a drain whose
+    deadline expires inside the swap window. Pre-fix, drain() returned
+    False at its deadline (the held queue never empties until the
+    probe resolves) and stop() flushed the held requests as errors —
+    now drain outlives the swap, restarts its budget once, and flushes
+    the released queue: zero accepted-request loss."""
+    srv = ServingServer(_ScaleModel(2.0), max_latency_ms=50.0,
+                        max_batch_size=8).start()
+    swap_result = {}
+
+    def do_swap():
+        swap_result["r"] = srv.swap_model(
+            "default", _SlowFirstScore(5.0, first_delay_s=1.2),
+            probe_payload={"x": 1.0})
+
+    results = [None] * 4
+
+    def call(i):
+        try:
+            results[i] = _post(srv.url, {"x": float(i)}, timeout=15.0)
+        except Exception as e:  # pragma: no cover - failure detail
+            results[i] = e
+
+    swapper = threading.Thread(target=do_swap, daemon=True)
+    try:
+        swapper.start()
+        # wait for the flip: the new model is in the registry, held
+        # out of the batch loop while its slow probe runs
+        deadline = time.monotonic() + 5.0
+        held = False
+        while time.monotonic() < deadline and not held:
+            with srv._lock:
+                held = srv._models["default"].held
+            time.sleep(0.002)
+        assert held, "swap never reached the held-probation window"
+        threads = [threading.Thread(target=call, args=(i,), daemon=True)
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with srv._lock:
+                if len(srv._models["default"].queue) >= 4:
+                    break
+            time.sleep(0.002)
+        # this deadline expires INSIDE the 1.2 s probe window — the
+        # pre-fix drain gave up right here
+        assert srv.drain(timeout_s=0.4)
+        swapper.join(timeout=10)
+        for t in threads:
+            t.join(timeout=10)
+        assert swap_result["r"]["model"] == "default"
+        # zero loss: every held request was scored by the NEW model
+        for i, out in enumerate(results):
+            assert isinstance(out, dict) and out["scaled"] == 5.0 * i, \
+                f"request {i} lost across drain-during-swap: {out!r}"
+    finally:
+        srv.stop()
+
+
 # -- chaos drill: kill mid-batch ---------------------------------------------
 
 def test_kill_mid_batch_failover_and_respawn():

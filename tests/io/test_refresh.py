@@ -322,6 +322,34 @@ def test_pump_joins_producer_thread(base, tmp_path):
     ctrl.close()
 
 
+def test_pump_joins_producer_on_ingest_fault(base, tmp_path):
+    """Regression (PR 17): an armed ``stream.ingest`` fault raising
+    out of pump()'s consumer loop must still join the background
+    producer thread (the PR 13 prefetcher contract) — no leaked
+    ``refresh-ingest`` thread, and the prefetcher's leak verdict is
+    surfaced in the controller's stats either way."""
+    model, _, _ = base
+    ctrl = RefreshController(_estimator(), model, str(tmp_path),
+                             buffer=StreamBuffer(capacity=4096),
+                             refresh_interval_s=10_000)
+
+    def stream():
+        for i in range(5):
+            x, y = _make_data(20 + i, n=64)
+            yield x, y
+
+    faults.arm("stream.ingest", "raise", nth=2, count=1)
+    with pytest.raises(FaultInjected):
+        ctrl.pump(stream(), depth=2)
+    assert not [t for t in threading.enumerate()
+                if "refresh-ingest" in t.name], "leaked producer thread"
+    # joined within the prefetcher's budget -> verdict recorded clean
+    assert ctrl.stats["leaked_thread"] is None
+    # the fault hit the SECOND put: the first block stayed buffered
+    assert ctrl.buffer.rows == 64
+    ctrl.close()
+
+
 def test_interval_trigger_and_zero_disables(base, tmp_path):
     model, _, _ = base
     x, y = _make_data(6)

@@ -58,6 +58,46 @@ class TestProfiles:
                 armed.add(p)
         assert armed == set(faults.KNOWN_POINTS)
 
+    def test_registry_and_call_sites_agree_both_directions(self):
+        """Registry completeness, both ways: every KNOWN_POINTS entry
+        has a production ``fault_point(...)`` call site, and every call
+        site names a registered point — an unregistered site would be
+        invisible to the fuzzer, a site-less registration fuzzes dead
+        air."""
+        import pathlib
+        import re
+
+        import mmlspark_tpu
+
+        pkg = pathlib.Path(mmlspark_tpu.__file__).parent
+        sites = set()
+        for path in pkg.rglob("*.py"):
+            if path.name == "faults.py":   # registry + usage examples
+                continue
+            for m in re.finditer(r'fault_point\(\s*\n?\s*"([a-z_.]+)"',
+                                 path.read_text()):
+                sites.add(m.group(1))
+        assert sites == set(faults.KNOWN_POINTS)
+
+    def test_platform_points_are_wired(self):
+        """The PR 17 points are registered, profiled, typed, and have
+        their call sites on the paths the combined scenario exercises."""
+        profs = cf.profiles()
+        for point in ("registry.swap_fanout", "serving.observe_log"):
+            assert point in faults.KNOWN_POINTS
+            assert point in profs
+        # a fan-out fault must surface as the serving plane's typed
+        # attributed error, not a bare FaultInjected leak
+        assert cf._TYPED_ERRORS["registry.swap_fanout"] == "SwapFailed"
+        import inspect
+
+        from mmlspark_tpu.io import fleet as fleet_mod
+        from mmlspark_tpu.io import serving as serving_mod
+        assert ('fault_point("registry.swap_fanout")'
+                in inspect.getsource(fleet_mod.FleetSupervisor))
+        assert ('fault_point("serving.observe_log")'
+                in inspect.getsource(serving_mod.ServingServer))
+
     def test_arm_schedule_fires_exactly_once(self):
         cf.arm_schedule((("gbdt.train_step", "raise", 1),))
         with pytest.raises(FaultInjected):
@@ -76,6 +116,28 @@ class TestDeterminism:
             b = [cf.sample_schedule(random.Random(7), scen, profs)
                  for _ in range(1)]
             assert a == b
+
+    def test_scenario5_pinned_seed_schedules(self):
+        """The train-while-serve scenario's sampled schedules are
+        pinned for one seed: the CI campaign's reproducibility claim
+        rests on the sampler being bit-stable across refactors."""
+        profs = cf.profiles()
+        scen = [s for s in sc.all_scenarios()
+                if s.name == "train_while_serve"][0]
+        rng = random.Random(17)
+        schedules = [cf.sample_schedule(rng, scen, profs)
+                     for _ in range(2)]
+        assert schedules == [
+            (("refresh.fit", "delay", 1),),
+            (("gbdt.train_step", "delay", 1),
+             ("io.disk_full", "delay", 3)),
+        ]
+        # armed points stay inside the registry (affinity plus the 20%
+        # full-registry tail)
+        for schedule in schedules:
+            for point, action, nth in schedule:
+                assert point in faults.KNOWN_POINTS
+                assert action in profs[point].actions
 
     def test_different_seeds_differ(self):
         profs = cf.profiles()

@@ -4,8 +4,8 @@
 fault *schedules* — (point, action, nth-hit) tuples drawn from the
 canonical ``mmlspark_tpu.core.faults.KNOWN_POINTS`` registry — and runs
 each against a small end-to-end scenario (in-core fit, out-of-core fit,
-streaming refresh, serving swap), asserting the framework's resilience
-invariants:
+streaming refresh, serving swap, and the composed train-while-serve
+platform loop), asserting the framework's resilience invariants:
 
   1. **no hang** — every schedule completes (or is aborted and counted
      as a violation) within the watchdog budget, enforced with
@@ -17,7 +17,11 @@ invariants:
      for ``registry.swap``); anonymous stack traces are violations;
   3. **recovery is bitwise** — a schedule that completes (first try or
      after one resume in the same work dir) must produce a fingerprint
-     identical to the unfaulted baseline.
+     identical to the unfaulted baseline;
+  4. **zero dropped requests** (train-while-serve only) — no in-flight
+     request may drop across a fleet-wide swap window unless a
+     serving-plane fault is armed, and a fan-out rollback leaves every
+     worker serving the old model bitwise-unchanged.
 
 Action profiles are derived from ``KNOWN_POINTS`` *at runtime*, so a
 fault point added in a future PR is fuzzed automatically with the
@@ -98,6 +102,7 @@ _TYPED_ERRORS = {
     "io.disk_full": "DiskFull",
     "spill.read": "SpillCorrupt",
     "registry.swap": "SwapFailed",
+    "registry.swap_fanout": "SwapFailed",
     "checkpoint.write": "CheckpointCorrupt",
 }
 

@@ -167,8 +167,14 @@ def serving(work_dir: str, armed: FrozenSet[str]) -> dict:
 _SERVING_POINTS = ("serving.score", "serving.worker_kill",
                    "registry.swap")
 
+# the train-while-serve scenario's wider accept-set: its requests can
+# also die to a fan-out rollback window or a failed worker bring-up
+_PLATFORM_POINTS = _SERVING_POINTS + ("registry.swap_fanout",
+                                      "fleet.spawn")
 
-def _serving_attributed(e: BaseException, armed: FrozenSet[str]) -> bool:
+
+def _serving_attributed(e: BaseException, armed: FrozenSet[str],
+                        points: Tuple[str, ...] = _SERVING_POINTS) -> bool:
     """Is this request/swap failure explained by an armed serving-plane
     fault?  HTTP 5xx bodies are scanned for the injected-fault marker;
     connection-level errors are accepted only while a fault that tears
@@ -183,7 +189,184 @@ def _serving_attributed(e: BaseException, armed: FrozenSet[str]) -> bool:
         return True
     if any(p in text for p in armed):
         return True
-    return any(p in armed for p in _SERVING_POINTS)
+    return any(p in armed for p in points)
+
+
+def train_while_serve(work_dir: str, armed: FrozenSet[str]) -> dict:
+    """Scenario 5: the composed online-platform loop on one supervised
+    fleet — serve → ingest (the refit window is the fleet's own scored
+    traffic via the request-log tap) → drift window → OOC warm-start
+    refit → generation checkpoint → fleet-wide two-phase hot-swap, with
+    a sustained client load across the whole swap window.
+
+    On top of the campaign's three standing invariants this scenario
+    checks the fourth: ZERO dropped in-flight requests across the swap
+    window unless a serving-plane fault (one that tears down replies)
+    is armed — a fan-out rollback in particular must not cost a single
+    accepted request, and must leave every worker serving the old
+    model bitwise-unchanged."""
+    import threading
+
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.core.env import env_override
+    from mmlspark_tpu.io.fleet import FleetSupervisor
+    from mmlspark_tpu.io.refresh import RefreshController
+    from mmlspark_tpu.io.serving import ServingFleet, SwapFailed
+
+    model = _base_model()
+    xs, ys = _data(5, 48, shift=0.6)
+    # ground-truth labeler for the tap: JSON round-trips float64
+    # exactly (repr shortest round-trip), so payload bytes key the row
+    label_by_key = {xs[i].tobytes(): float(ys[i]) for i in range(len(xs))}
+    replies: Dict[str, float] = {}
+    fingerprint: Dict[str, object] = {}
+    dead: list = []
+
+    def attributed(e: BaseException) -> bool:
+        return _serving_attributed(e, armed, points=_PLATFORM_POINTS)
+
+    fleet = ServingFleet(model, num_servers=2, max_batch_size=4,
+                         max_latency_ms=2.0)
+    sup = FleetSupervisor(fleet, min_workers=2, max_workers=2)
+    with fleet:
+        w0, w1 = fleet.servers
+        ctrl = RefreshController(
+            _estimator(), model, os.path.join(work_dir, "ckpt"),
+            refresh_interval_s=10_000, min_refit_rows=32,
+            segment_interval=2)
+
+        def req(server, i: int, key: Optional[str] = None):
+            """One scored request; records under ``key``, returns the
+            prediction, or None after an *attributed* failure."""
+            try:
+                r = _post(server.url,
+                          {"features": xs[i].tolist()}, timeout=10)
+                value = float(r["prediction"])
+            except Exception as e:
+                if not attributed(e):
+                    raise Unattributed(
+                        f"request {key or i} failed outside any armed "
+                        f"fault: {type(e).__name__}: {e}") from e
+                if "serving.worker_kill" in armed and server not in dead:
+                    dead.append(server)
+                return None
+            if key is not None:
+                replies[key] = value
+            return value
+
+        ctrl.tap_serving(
+            w0, label_fn=lambda payload, reply: label_by_key.get(
+                np.asarray(payload["features"],
+                           dtype=np.float64).tobytes()))
+        # serve + ingest: w0's traffic is the tapped refit source; w1
+        # takes fleet traffic that stays out of the window
+        for i in range(16):
+            req(w0, i, key=str(i))
+        for i in range(16, 24):
+            req(w1, i, key=str(i))
+        # reconcile against the durable request log: if any armed fault
+        # cost tap rows (a 500'd batch, a dying tap, a dying ingest
+        # producer), replay the FULL window in sent order — the refit
+        # must train on exactly the sent rows either way
+        wx, wy = ctrl.buffer.drain()
+        sent_x, sent_y = xs[:16], ys[:16]
+        if not (wx.shape == sent_x.shape and np.array_equal(wx, sent_x)
+                and np.array_equal(wy, sent_y)):
+            wx, wy = sent_x, sent_y
+        ctrl.observe(wx, wy)
+        # drift-batch backfill from the feature store, fixed seed: the
+        # window is identical across runs and attempts
+        bx, by = _data(6, 176, shift=0.6)
+        ctrl.observe(bx, by)
+        if ctrl.generation > 0:
+            # resumed attempt: the previous try committed this refit;
+            # refitting again would mint a divergent generation 2
+            new_model = ctrl.model
+        else:
+            with env_override("MMLSPARK_TPU_OOC", "on"), \
+                    env_override("MMLSPARK_TPU_OOC_CHUNK_ROWS", "1024"):
+                new_model = ctrl.refresh(swap=False).model
+        fingerprint["model"] = new_model.get_model_string()
+
+        # fleet-wide two-phase swap under sustained client load; every
+        # reply across the window must be bitwise one of the two
+        # generations, and (absent a serving-plane fault) none may drop
+        probe_x = xs[24:32]
+        old_pred = model.transform(
+            DataFrame({"features": probe_x})).col("prediction")
+        new_pred = new_model.transform(
+            DataFrame({"features": probe_x})).col("prediction")
+        outcomes: list = []
+        stop_ev = threading.Event()
+        target = w1 if w1 not in dead else w0
+
+        def hammer():
+            j = 0
+            while not stop_ev.is_set() and j < 400:
+                i = j % len(probe_x)
+                try:
+                    r = _post(target.url,
+                              {"features": probe_x[i].tolist()},
+                              timeout=10)
+                    outcomes.append((i, float(r["prediction"])))
+                except Exception as e:
+                    outcomes.append((i, e))
+                j += 1
+
+        loader = threading.Thread(target=hammer, daemon=True,
+                                  name="chaosfuzz-swap-load")
+        loader.start()
+        swap_error: Optional[BaseException] = None
+        try:
+            fleet_swap = sup.swap_model_fleet(
+                w0._default, new_model,
+                probe_payload={"features": xs[0].tolist()})
+        except SwapFailed as e:
+            # rollback contract: old model keeps serving everywhere
+            fleet_swap = None
+            swap_error = e
+        finally:
+            stop_ev.set()
+            loader.join(timeout=30)
+        if loader.is_alive():
+            raise Unattributed("swap-window load generator hung")
+        serving_faulted = bool(
+            armed & {"serving.score", "serving.worker_kill"})
+        for i, out in outcomes:
+            if isinstance(out, Exception):
+                if not serving_faulted:
+                    raise Unattributed(
+                        f"in-flight request dropped across the swap "
+                        f"window with no serving-plane fault armed "
+                        f"(swap {'rolled back' if fleet_swap is None else 'committed'}): "
+                        f"{type(out).__name__}: {out}") from out
+            elif (out != float(old_pred[i])
+                    and out != float(new_pred[i])):
+                raise Unattributed(
+                    f"swap-window reply {out!r} matches neither the "
+                    f"old ({float(old_pred[i])!r}) nor the new "
+                    f"({float(new_pred[i])!r}) generation bitwise")
+
+        # post-swap: committed runs fingerprint the new generation's
+        # replies; rolled-back runs must still serve the OLD model
+        # bitwise-unchanged on every worker
+        post_x = xs[40:48]
+        post_old = model.transform(
+            DataFrame({"features": post_x})).col("prediction")
+        targets = [w for w in (w0, w1) if w not in dead] or [w1]
+        for i in range(8):
+            value = req(targets[i % len(targets)], 40 + i)
+            if value is None:
+                continue
+            if fleet_swap is not None:
+                replies[f"post{i}"] = value
+            elif value != float(post_old[i]):
+                raise Unattributed(
+                    f"after fleet-swap rollback ({swap_error}), a "
+                    f"worker's reply diverged from the old model: "
+                    f"{value!r} vs {float(post_old[i])!r}")
+    fingerprint["replies"] = replies
+    return fingerprint
 
 
 def _compare_exact(baseline: dict, run: dict) -> Optional[str]:
@@ -204,6 +387,17 @@ def _compare_replies(baseline: dict, run: dict) -> Optional[str]:
             return (f"reply {idx} diverged: baseline={base[idx]!r} "
                     f"run={score!r}")
     return None
+
+
+def _compare_platform(baseline: dict, run: dict) -> Optional[str]:
+    """train_while_serve comparator: the committed refit model must be
+    bitwise-identical to the baseline's (a completed run always
+    refits), and whatever replies the run produced must match the
+    baseline's bitwise (post-swap replies exist only when the swap
+    committed — a rolled-back run simply has none to compare)."""
+    if run.get("model") != baseline.get("model"):
+        return "refit generation diverged from the unfaulted baseline"
+    return _compare_replies(baseline, run)
 
 
 @dataclass(frozen=True)
@@ -236,4 +430,11 @@ def all_scenarios() -> Tuple[Scenario, ...]:
                  ("serving.score", "serving.worker_kill",
                   "registry.swap"),
                  resumable=False, compare=_compare_replies),
+        Scenario("train_while_serve", train_while_serve,
+                 ("registry.swap_fanout", "serving.observe_log",
+                  "registry.swap", "serving.score",
+                  "serving.worker_kill", "stream.ingest",
+                  "refresh.fit", "checkpoint.write", "io.disk_full",
+                  "spill.read", "gbdt.train_step", "fleet.spawn"),
+                 compare=_compare_platform),
     )
