@@ -115,6 +115,12 @@ SAN_RECOMPILE_BUDGET = register(
     "MMLSPARK_TPU_SAN_RECOMPILE_BUDGET", "int", 0,
     "with graftsan enabled: max compilations per process before "
     "RecompileBudgetExceeded (0 = count only, never raise)")
+SAN_LOCK_HOLD_MS = register(
+    "MMLSPARK_TPU_SAN_LOCK_HOLD_MS", "float", 0.0,
+    "with graftsan enabled: warn (SanLockHoldWarning) when a san_lock "
+    "is held longer than this many milliseconds, naming the acquire "
+    "site (0 = hold-time check off; order-inversion detection is "
+    "always on under MMLSPARK_TPU_SAN=1)")
 HIST_QUANT = register(
     "MMLSPARK_TPU_HIST_QUANT", "str", "off",
     "gradient/hessian quantization for histogram construction: "

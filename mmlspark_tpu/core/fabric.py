@@ -91,7 +91,8 @@ class FabricClient:
             return
         # prune finished posts so long-lived emitters don't accumulate
         # dead Thread objects; concurrent emitters share the list
-        t = threading.Thread(target=self._post, args=(record,), daemon=True)
+        t = threading.Thread(target=self._post, args=(record,),
+                             name="mmlspark-fabric-post", daemon=True)
         with self._threads_lock:
             self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)

@@ -21,6 +21,16 @@ manifest with real data on real meshes:
   misses through :func:`count_recompile`; a per-process budget
   (``MMLSPARK_TPU_SAN_RECOMPILE_BUDGET``) turns GL003's static
   recompilation hazards into a hard runtime signal.
+* **lock-order recorder (graftlock)** — :func:`san_lock` wraps the
+  serving plane's locks/conditions; enabled, every acquire records the
+  per-thread held-set and checks the acquisition against a global
+  lock-order graph, raising :class:`LockOrderViolation` (naming the
+  thread, the held locks and both call sites) *before* blocking when
+  two threads ever acquire the same pair in opposite orders — the
+  runtime counterpart of GL009's static cycle detection. Hold times
+  past ``MMLSPARK_TPU_SAN_LOCK_HOLD_MS`` warn with the acquire site
+  (GL012's runtime counterpart: the blocking-under-lock amplifier
+  shows up as a long hold).
 
 Zero-overhead contract (same pattern as ``faults.fault_point``): every
 entry point reads ONE module-global boolean and returns immediately
@@ -38,19 +48,23 @@ comparable.
 from __future__ import annotations
 
 import hashlib
+import sys
 import threading
+import time
 import warnings
 from contextlib import contextmanager
-from typing import Any, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 __all__ = [
     "SanitizerError", "NonFiniteError", "CollectiveDivergence",
-    "RecompileBudgetExceeded", "enabled", "enable", "disable",
+    "RecompileBudgetExceeded", "LockOrderViolation",
+    "SanLockHoldWarning", "enabled", "enable", "disable",
     "refresh_from_env", "reset", "check_finite", "record_collective",
     "CollectiveRecorder", "recorder", "use_recorder", "last_collective",
     "step_boundary",
     "crosscheck_hashes", "count_recompile", "recompile_count",
-    "set_recompile_budget",
+    "set_recompile_budget", "san_lock", "set_lock_hold_budget_ms",
+    "lock_order_edges",
 ]
 
 
@@ -68,6 +82,24 @@ class CollectiveDivergence(SanitizerError):
 
 class RecompileBudgetExceeded(SanitizerError):
     """More compilations than the per-process budget allows."""
+
+
+class LockOrderViolation(SanitizerError):
+    """Two threads acquired the same lock pair in opposite orders (the
+    ABBA deadlock class). Carries the acquiring thread's name, the
+    names of the locks it already held, and the lock it was about to
+    take; the message names both call sites."""
+
+    def __init__(self, message: str, thread: str = "",
+                 held: Sequence[str] = (), acquiring: str = "") -> None:
+        super().__init__(message)
+        self.thread = thread
+        self.held = tuple(held)
+        self.acquiring = acquiring
+
+
+class SanLockHoldWarning(RuntimeWarning):
+    """A san_lock was held past MMLSPARK_TPU_SAN_LOCK_HOLD_MS."""
 
 
 # fast-path flag: every public entry point checks this one module
@@ -97,22 +129,31 @@ def disable() -> None:
 
 def refresh_from_env() -> None:
     """Re-read ``MMLSPARK_TPU_SAN`` / ``MMLSPARK_TPU_SAN_RECOMPILE_BUDGET``
-    (call after changing them in-process, e.g. under ``env_override``)."""
-    global _enabled, _recompile_budget
-    from mmlspark_tpu.core.env import (SAN, SAN_RECOMPILE_BUDGET,
-                                       env_flag, env_int)
+    / ``MMLSPARK_TPU_SAN_LOCK_HOLD_MS`` (call after changing them
+    in-process, e.g. under ``env_override``)."""
+    global _enabled, _recompile_budget, _lock_hold_budget_ms
+    from mmlspark_tpu.core.env import (SAN, SAN_LOCK_HOLD_MS,
+                                       SAN_RECOMPILE_BUDGET, env_flag,
+                                       env_float, env_int)
     _enabled = env_flag(SAN, False)
     _recompile_budget = env_int(SAN_RECOMPILE_BUDGET, 0, minimum=0)
+    _lock_hold_budget_ms = env_float(SAN_LOCK_HOLD_MS, 0.0, minimum=0.0)
 
 
 def reset() -> None:
-    """Clear recorded state (collective events, recompile counter)
-    without touching the enabled flag. Run-start and test hook."""
+    """Clear recorded state (collective events, recompile counter,
+    lock-order graph) without touching the enabled flag. Run-start and
+    test hook."""
     global _recompiles
     with _lock:
         _recompiles = 0
         _recent_recompiles.clear()
     _recorder.clear()
+    with _order_lock:
+        _order_edges.clear()
+    # held stacks are thread-local; clear at least the calling thread's
+    # so a test that aborted mid-acquire starts clean
+    getattr(_tls, "held", []) and _tls.held.clear()
 
 
 # --- NaN/Inf jit-boundary guards -------------------------------------------
@@ -367,6 +408,206 @@ def recompile_count() -> int:
 def set_recompile_budget(budget: int) -> None:
     global _recompile_budget
     _recompile_budget = max(0, int(budget))
+
+
+# --- lock-discipline recorder (graftlock runtime twin) ----------------------
+
+_lock_hold_budget_ms = 0.0     # 0 = hold-time check off
+_order_lock = threading.Lock()
+# directed lock-order edges: (held, acquired) -> (held site, acquire
+# site) of the first acquisition that established the order
+_order_edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
+_tls = threading.local()
+
+_THIS_FILE = __file__
+
+
+def _held_stack() -> List[Tuple[str, str, float]]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _call_site() -> str:
+    """``path:line`` of the nearest frame outside this module — the
+    production call site that acquired/released the lock."""
+    f = sys._getframe(1)
+    while f is not None and f.f_code.co_filename == _THIS_FILE:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    fn = f.f_code.co_filename
+    parts = fn.replace("\\", "/").rsplit("/", 3)[-2:]
+    return f"{'/'.join(parts)}:{f.f_lineno}"
+
+
+def _check_order(name: str, site: str) -> None:
+    """Raise :class:`LockOrderViolation` if taking ``name`` while the
+    current held-set contains a lock that some thread has ever taken
+    *after* ``name`` — i.e. the (held, name) pair has been seen in the
+    opposite order. Called before blocking on the real lock, so the
+    ABBA drill aborts instead of deadlocking."""
+    held = _held_stack()
+    if not held:
+        return
+    thread = threading.current_thread().name
+    with _order_lock:
+        for h_name, h_site, _t0 in held:
+            if h_name == name:
+                continue    # reentrant re-acquire: not an order edge
+            rev = _order_edges.get((name, h_name))
+            if rev is not None:
+                raise LockOrderViolation(
+                    f"graftsan: lock-order inversion (potential ABBA "
+                    f"deadlock): thread {thread!r} holds {h_name!r} "
+                    f"(acquired at {h_site}) and is acquiring {name!r} "
+                    f"at {site}, but the opposite order "
+                    f"{name!r} -> {h_name!r} was recorded earlier "
+                    f"(held at {rev[0]}, acquired at {rev[1]}); pick "
+                    f"one acquisition order for this pair",
+                    thread=thread,
+                    held=[h for h, _s, _t in held],
+                    acquiring=name)
+            _order_edges.setdefault((h_name, name), (h_site, site))
+
+
+def _note_acquired(name: str, site: str) -> None:
+    _held_stack().append((name, site, time.perf_counter()))
+
+
+def _note_released(name: str) -> None:
+    held = _held_stack()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][0] == name:
+            _n, site, t0 = held.pop(i)
+            budget = _lock_hold_budget_ms
+            if budget > 0.0:
+                ms = (time.perf_counter() - t0) * 1e3
+                if ms > budget:
+                    warnings.warn(
+                        f"graftsan: lock {name!r} held {ms:.1f}ms > "
+                        f"MMLSPARK_TPU_SAN_LOCK_HOLD_MS={budget:g} "
+                        f"(acquired at {site}, released at "
+                        f"{_call_site()}) — hoist blocking work out "
+                        f"of the critical section (GL012)",
+                        SanLockHoldWarning, stacklevel=3)
+            return
+
+
+class _SanLock:
+    """Lock wrapper produced by :func:`san_lock`. Disabled, every
+    operation is one module-global check plus direct delegation to the
+    wrapped ``threading`` primitive (the fault_point contract: the
+    serving data plane pays ~a hundred ns per acquire)."""
+
+    __slots__ = ("name", "_lock")
+
+    def __init__(self, name: str, lock: Any) -> None:
+        self.name = name
+        self._lock = lock
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if not _enabled:
+            return self._lock.acquire(blocking, timeout)
+        site = _call_site()
+        _check_order(self.name, site)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            _note_acquired(self.name, site)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        if _enabled:
+            _note_released(self.name)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "_SanLock":
+        if not _enabled:
+            self._lock.acquire()
+            return self
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        if not _enabled:
+            self._lock.release()
+            return False
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<san_lock {self.name!r} wrapping {self._lock!r}>"
+
+
+class _SanCondition(_SanLock):
+    """Condition wrapper: wait()/notify() delegate to the wrapped
+    ``threading.Condition``; for hold-time accounting a ``wait`` is a
+    release + re-acquire (the condition drops the lock while parked, so
+    parked time must not count against the hold budget)."""
+
+    __slots__ = ()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if not _enabled:
+            return self._lock.wait(timeout)
+        _note_released(self.name)
+        try:
+            return self._lock.wait(timeout)
+        finally:
+            _note_acquired(self.name, _call_site())
+
+    def wait_for(self, predicate: Any,
+                 timeout: Optional[float] = None) -> Any:
+        if not _enabled:
+            return self._lock.wait_for(predicate, timeout)
+        _note_released(self.name)
+        try:
+            return self._lock.wait_for(predicate, timeout)
+        finally:
+            _note_acquired(self.name, _call_site())
+
+    def notify(self, n: int = 1) -> None:
+        self._lock.notify(n)
+
+    def notify_all(self) -> None:
+        self._lock.notify_all()
+
+
+def san_lock(name: str, kind: str = "lock") -> _SanLock:
+    """Factory for discipline-monitored locks, adopted by the threaded
+    serving plane (serving/fleet/refresh/prefetch/resilience).
+
+    ``kind`` is ``"lock"`` (default), ``"rlock"`` or ``"condition"``.
+    ``name`` keys the global lock-order graph — instances of the same
+    class share a name, so an order established on one server instance
+    constrains every other (exactly what ABBA detection wants).
+    Disabled (the default), the wrapper adds one boolean check per
+    operation; graftlint's GL009–GL012 recognize ``san_lock(...)``
+    attribute assignments the same way as bare ``threading`` locks."""
+    if kind == "lock":
+        return _SanLock(name, threading.Lock())
+    if kind == "rlock":
+        return _SanLock(name, threading.RLock())
+    if kind == "condition":
+        return _SanCondition(name, threading.Condition())
+    raise ValueError(
+        f"san_lock: unknown kind {kind!r} (expected 'lock', 'rlock' "
+        f"or 'condition')")
+
+
+def set_lock_hold_budget_ms(ms: float) -> None:
+    global _lock_hold_budget_ms
+    _lock_hold_budget_ms = max(0.0, float(ms))
+
+
+def lock_order_edges() -> Dict[Tuple[str, str], Tuple[str, str]]:
+    """Snapshot of the recorded lock-order graph (test/debug hook)."""
+    with _order_lock:
+        return dict(_order_edges)
 
 
 # arm from the environment at import, like faults.arm_from_env()

@@ -61,6 +61,7 @@ from mmlspark_tpu.core.env import (REFRESH_INTERVAL_S, REFRESH_PRIORITY,
                                    env_float, env_int, env_str)
 from mmlspark_tpu.core.faults import fault_point
 from mmlspark_tpu.core.logging_utils import logger, warn_once
+from mmlspark_tpu.core.sanitizer import san_lock
 from mmlspark_tpu.core.serialize import (dir_digest,
                                          load_latest_checkpoint,
                                          load_stage, save_checkpoint,
@@ -90,7 +91,7 @@ class StreamBuffer:
         if capacity <= 0:
             raise ValueError(f"capacity must be positive, got {capacity}")
         self.capacity = int(capacity)
-        self._lock = threading.Condition()
+        self._lock = san_lock("refresh.stream_buffer", kind="condition")
         self._blocks: list = []          # [(x_block, y_block), ...]
         self._rows = 0
         self._closed = False
@@ -123,15 +124,16 @@ class StreamBuffer:
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
         with self._lock:
+            # canonical predicate loop (GL011): the backpressure
+            # condition is re-tested after every wakeup, and the wait
+            # itself carries no control flow of its own
             while (not self._closed and self._rows > 0
                    and self._rows + len(x) > self.capacity):
-                if deadline is None:
-                    self._lock.wait(0.5)
-                else:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        return False
-                    self._lock.wait(remaining)
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._lock.wait(0.5 if remaining is None else remaining)
             if self._closed:
                 raise RuntimeError("put() on a closed StreamBuffer")
             self._blocks.append((x, y))

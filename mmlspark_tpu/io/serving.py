@@ -125,7 +125,7 @@ class _CappedThreadingHTTPServer(ThreadingHTTPServer):
         # live per-connection sockets, so an abrupt kill() can reset
         # every in-flight client (the chaos contract: a dead worker
         # looks DEAD — connection errors, not polite 5xx replies)
-        self._active_lock = threading.Lock()
+        self._active_lock = sanitizer.san_lock("serving.http.active")
         self._active: set = set()
 
     def process_request(self, request, client_address):
@@ -445,7 +445,7 @@ class ServingServer:
         self._warm: "OrderedDict[str, None]" = OrderedDict()
         self._warm_capacity = env_int(SERVE_WARM_MODELS, 4, minimum=1)
         self._ladder: List[int] = _bucket_ladder(max_batch_size)
-        self._lock = threading.Condition()
+        self._lock = sanitizer.san_lock("serving.server", kind="condition")
         self._stop = False
         self._stats = {"served": 0, "errors": 0, "rejected": 0,
                        "timeouts": 0, "swaps": 0, "swap_rollbacks": 0,
@@ -1234,6 +1234,9 @@ FleetSupervisor` notices via missed heartbeats and respawns."""
         deadline = time.monotonic() + timeout_s
         extended = False
         with self._lock:
+            # canonical predicate loop (GL011): every exit condition is
+            # re-tested at the top after each wakeup; the single wait at
+            # the bottom carries no control flow of its own
             while True:
                 depth = sum(len(m.queue) for m in self._models.values())
                 swapping = bool(self._swapping)
@@ -1244,14 +1247,14 @@ FleetSupervisor` notices via missed heartbeats and respawns."""
                 if remaining <= 0:
                     if swapping:
                         extended = True
-                        self._lock.wait(timeout=0.1)
-                        continue
-                    if extended:
+                    elif extended:
                         extended = False
                         deadline = time.monotonic() + timeout_s
                         continue
-                    return False
-                self._lock.wait(timeout=min(remaining, 0.1))
+                    else:
+                        return False
+                self._lock.wait(timeout=(min(remaining, 0.1)
+                                         if remaining > 0 else 0.1))
 
     @property
     def url(self) -> str:
@@ -1281,8 +1284,12 @@ FleetSupervisor` notices via missed heartbeats and respawns."""
     def _batch_loop(self):
         while not self._stop:
             with self._lock:
+                # canonical predicate loop (GL011): re-test for pending
+                # work after every wakeup instead of waiting once under
+                # an if — the stop flag is re-checked each pass, so
+                # shutdown responsiveness matches the old 0.5s poll
                 served = self._next_served()
-                if served is None:
+                while served is None and not self._stop:
                     self._lock.wait(timeout=0.5)
                     served = self._next_served()
                 if served is None:
@@ -1430,7 +1437,7 @@ class ContinuousServingServer(ServingServer):
                  warmup_payload: Optional[dict] = None, **kwargs):
         kwargs.setdefault("max_batch_size", 1)
         super().__init__(model, warmup_payload=warmup_payload, **kwargs)
-        self._score_lock = threading.Lock()
+        self._score_lock = sanitizer.san_lock("serving.continuous.score")
         # synchronous mode has no queue; the backpressure bound caps
         # how many requests may WAIT on the scorer lock at once
         self._inflight = threading.BoundedSemaphore(max(self.max_queue, 1))
@@ -1492,7 +1499,7 @@ class ServingFleet:
         self._continuous = continuous
         self._host = host
         self._server_kwargs = dict(server_kwargs)
-        self._servers_lock = threading.Lock()
+        self._servers_lock = sanitizer.san_lock("serving.fleet.servers")
         self._started = False
         self.servers = [self._make_server() for _ in range(num_servers)]
         fleet = self
@@ -1648,7 +1655,7 @@ class FleetClient:
         self.route_around_degraded = route_around_degraded
         self._workers: List[str] = []
         self._next = 0
-        self._lock = threading.Lock()
+        self._lock = sanitizer.san_lock("serving.fleet.client")
         self._registry_count = 0
         self._last_refresh = 0.0
         self._degraded: Dict[str, float] = {}  # url -> marked time

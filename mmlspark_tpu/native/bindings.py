@@ -18,20 +18,44 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libmmlspark_native.so")
 
-_lock = threading.Lock()
+_lock = sanitizer.san_lock("native.build")
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
+_building = False
+_build_done = threading.Event()
 _quant_symbols = False
 
 
 def ensure_built() -> bool:
-    """Compile the shared library if missing; returns availability."""
-    global _lib, _build_failed
+    """Compile the shared library if missing; returns availability.
+
+    The compile (make, up to 120s) runs OUTSIDE ``_lock``: one caller
+    is elected builder under the lock, concurrent callers park on
+    ``_build_done`` — holding a lock across a subprocess would stall
+    every thread that merely wants the cached availability answer
+    (GL012, blocking-under-lock)."""
+    global _lib, _build_failed, _building
     with _lock:
         if _lib is not None:
             return True
         if _build_failed:
             return False
+        if _building:
+            elected = False
+        else:
+            _building = True
+            _build_done.clear()
+            elected = True
+    if not elected:
+        # another thread is compiling: wait for its verdict (bounded
+        # well past the make timeout so a crashed builder can't park
+        # us forever), then read the published result
+        _build_done.wait(timeout=300)
+        with _lock:
+            return _lib is not None
+    lib: Optional[ctypes.CDLL] = None
+    failed = False
+    try:
         # always run make: it is a no-op when the .so is fresh and
         # rebuilds when data_plane.cpp is newer (a stale library would
         # silently miss symbols added since it was built)
@@ -42,20 +66,27 @@ def ensure_built() -> bool:
             if not os.path.exists(_SO_PATH):
                 logger.warning("native build failed (%s); using numpy "
                                "fallbacks", e)
-                _build_failed = True
-                return False
-            logger.warning("native rebuild failed (%s); loading the "
-                           "existing library", e)
-        try:
-            lib = ctypes.CDLL(_SO_PATH)
-        except OSError as e:
-            logger.warning("native load failed (%s); using numpy "
-                           "fallbacks", e)
-            _build_failed = True
-            return False
-        _configure(lib)
-        _lib = lib
-        return True
+                failed = True
+            else:
+                logger.warning("native rebuild failed (%s); loading "
+                               "the existing library", e)
+        if not failed:
+            try:
+                loaded = ctypes.CDLL(_SO_PATH)
+            except OSError as e:
+                logger.warning("native load failed (%s); using numpy "
+                               "fallbacks", e)
+                failed = True
+            else:
+                _configure(loaded)
+                lib = loaded    # published only once fully configured
+    finally:
+        with _lock:
+            _lib = lib
+            _build_failed = failed or lib is None
+            _building = False
+        _build_done.set()
+    return lib is not None
 
 
 def _configure(lib: ctypes.CDLL) -> None:

@@ -63,6 +63,7 @@ from mmlspark_tpu.core.env import (RECOVERY_MAX, RECOVERY_MIN_DP,
                                    WATCHDOG_INIT_S, WATCHDOG_MIN_S,
                                    WATCHDOG_MULT, env_float, env_int)
 from mmlspark_tpu.core.logging_utils import logger
+from mmlspark_tpu.core.sanitizer import san_lock
 
 __all__ = [
     "TrainStalled", "ParticipantLost", "TrainWatchdog", "FitRecovery",
@@ -109,7 +110,7 @@ class _WatchdogInterrupt(BaseException):
 
 _active: Optional["TrainWatchdog"] = None
 _step_throttle: Optional[Callable[[Any], None]] = None
-_lock = threading.Lock()
+_lock = san_lock("resilience.state")
 _stall_count = 0
 _recovery_count = 0
 
@@ -309,7 +310,7 @@ class TrainWatchdog:
                 self._prev_handler = None
         self._monitor = threading.Thread(
             target=self._monitor_loop,
-            name=f"graft-watchdog-{self.label}", daemon=True)
+            name=f"mmlspark-watchdog-{self.label}", daemon=True)
         self._monitor.start()
         return self
 

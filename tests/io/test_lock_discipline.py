@@ -1,0 +1,139 @@
+"""Lock-discipline regression gate for the threaded serving plane.
+
+Every concurrency fix this rule set forced (condition predicate loops
+in serving/refresh, the bindings builder election that hoisted the
+make/CDLL work out of the module lock, the unified mmlspark- thread
+naming) is pinned here two ways: the per-file graftlint scan stays at
+zero findings for GL009-GL012 with the shipped EMPTY baseline, and the
+behavioral contracts (builder election under contention, backpressure
+wakeup on close) are exercised directly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tools.graftlint.core import run_checks
+
+pytestmark = pytest.mark.lock_smoke
+
+REPO = Path(__file__).resolve().parents[2]
+
+# the production files the graftlock rules flagged and this PR fixed —
+# each stays clean under the full quartet, per file, no baseline
+FIXED_FILES = [
+    "mmlspark_tpu/io/serving.py",
+    "mmlspark_tpu/io/fleet.py",
+    "mmlspark_tpu/io/refresh.py",
+    "mmlspark_tpu/parallel/prefetch.py",
+    "mmlspark_tpu/parallel/resilience.py",
+    "mmlspark_tpu/native/bindings.py",
+    "mmlspark_tpu/core/fabric.py",
+]
+
+
+@pytest.mark.parametrize("rel", FIXED_FILES)
+def test_fixed_file_stays_clean_under_lock_rules(rel):
+    _, findings = run_checks([REPO / rel],
+                             select=["GL009", "GL010", "GL011", "GL012"],
+                             repo_root=REPO)
+    assert findings == [], [f"{f.location()} {f.rule} {f.message}"
+                            for f in findings]
+
+
+def test_bindings_builder_election_under_contention():
+    """ensure_built from many threads at once: exactly one caller runs
+    the build while the rest park on the build-done event (the make +
+    CDLL work no longer happens under the module lock), and every
+    caller agrees on the outcome."""
+    from mmlspark_tpu.native import bindings
+
+    results = []
+    results_lock = threading.Lock()
+    start = threading.Barrier(8)
+
+    def call():
+        start.wait(5.0)
+        ok = bindings.ensure_built()
+        with results_lock:
+            results.append(ok)
+
+    threads = [threading.Thread(target=call,
+                                name=f"mmlspark-buildtest-{i}")
+               for i in range(8)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    assert not [t for t in threads if t.is_alive()], "ensure_built hung"
+    assert len(results) == 8
+    assert len(set(results)) == 1, f"callers disagreed: {results}"
+    # the .so ships prebuilt (or was built by an earlier test): the
+    # contended path must be fast-path reads, not serialized rebuilds
+    if results[0]:
+        assert time.perf_counter() - t0 < 20.0
+
+
+def test_stream_buffer_close_wakes_blocked_put():
+    """The GL011 rewrite of StreamBuffer.put (single timed wait in a
+    while-predicate loop): a producer blocked on backpressure must see
+    close() promptly instead of sleeping out a poll interval."""
+    from mmlspark_tpu.io.refresh import StreamBuffer
+
+    buf = StreamBuffer(capacity=4)
+    assert buf.put(np.ones((4, 2)), np.ones(4))
+
+    unblocked = threading.Event()
+    outcome = []
+
+    def producer():
+        # over capacity with rows pending: parks until close() wakes
+        # the wait and the re-tested predicate sees the closed flag
+        try:
+            outcome.append(buf.put(np.ones((4, 2)), np.ones(4),
+                                   timeout=10.0))
+        except RuntimeError as e:
+            outcome.append(str(e))
+        unblocked.set()
+
+    t = threading.Thread(target=producer, name="mmlspark-puttest")
+    t.start()
+    time.sleep(0.1)
+    assert not unblocked.is_set(), "put should be parked on capacity"
+    t0 = time.perf_counter()
+    buf.close()
+    assert unblocked.wait(5.0), "close() did not wake the producer"
+    wake = time.perf_counter() - t0
+    t.join(5.0)
+    assert wake < 2.0, f"wakeup took {wake:.2f}s"
+    assert outcome == ["put() on a closed StreamBuffer"]
+
+
+def test_serving_plane_threads_carry_unified_prefix():
+    """Satellite contract: every daemon the serving plane spawns uses
+    the mmlspark- prefix (GL010 keys thread discovery off it)."""
+    from mmlspark_tpu.core.pipeline import Transformer
+    from mmlspark_tpu.io.serving import ServingServer
+
+    class Echo(Transformer):
+        def _transform(self, df):
+            return df.with_column("prediction",
+                                  np.zeros(len(df), np.float32))
+
+    before = {t.name for t in threading.enumerate()}
+    srv = ServingServer(Echo(), port=0)
+    srv.start()
+    try:
+        spawned = [t.name for t in threading.enumerate()
+                   if t.name not in before]
+        assert spawned, "server spawned no threads?"
+        offenders = [n for n in spawned if not n.startswith("mmlspark-")]
+        assert not offenders, offenders
+    finally:
+        srv.stop()

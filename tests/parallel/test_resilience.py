@@ -104,7 +104,7 @@ class TestWatchdog:
         # the monitor thread must not linger past the fit
         time.sleep(0.05)
         assert not [t for t in threading.enumerate()
-                    if t.name.startswith("graft-watchdog-")]
+                    if t.name.startswith("mmlspark-watchdog-")]
 
     def test_watchdog_off_delay_completes_bitwise(self):
         """Default env (MULT=0): the same armed delay merely slows the

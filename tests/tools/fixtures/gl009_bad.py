@@ -1,0 +1,44 @@
+# seeded GL009 violations: lock-order inversions (ABBA deadlock shapes)
+import threading
+
+
+class Exchange:
+    """Direct two-lock inversion: deposit takes a->b, withdraw b->a."""
+
+    def __init__(self):
+        self._accounts = threading.Lock()
+        self._audit = threading.Lock()
+        self.total = 0
+
+    def deposit(self, n):
+        with self._accounts:
+            with self._audit:
+                self.total += n
+
+    def withdraw(self, n):
+        with self._audit:
+            with self._accounts:
+                self.total -= n
+
+
+class Router:
+    """Inversion hidden one helper deep: flush takes table->stats via
+    _bump, rebalance takes stats->table directly."""
+
+    def __init__(self):
+        self._table = threading.Lock()
+        self._stats = threading.Lock()
+        self.flushed = 0
+
+    def _bump(self):
+        with self._stats:
+            self.flushed += 1
+
+    def flush(self):
+        with self._table:
+            self._bump()
+
+    def rebalance(self):
+        with self._stats:
+            with self._table:
+                self.flushed = 0

@@ -1,0 +1,51 @@
+# clean GL009 negatives: consistent lock order, reentrancy, san_lock
+import threading
+
+from mmlspark_tpu.core.sanitizer import san_lock
+
+
+class Exchange:
+    """Both paths take accounts -> audit: one global order, no cycle."""
+
+    def __init__(self):
+        self._accounts = threading.Lock()
+        self._audit = threading.Lock()
+        self.total = 0
+
+    def deposit(self, n):
+        with self._accounts:
+            with self._audit:
+                self.total += n
+
+    def withdraw(self, n):
+        with self._accounts:
+            with self._audit:
+                self.total -= n
+
+
+class Recorder:
+    """Reentrant re-acquire of the same RLock is not an order edge,
+    and sequential acquire/release in one order is fine."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._sink = san_lock("fixture.recorder.sink")
+        self.rows = 0
+
+    def record(self, n):
+        with self._lock:
+            with self._lock:
+                self.rows += n
+
+    def drain(self):
+        self._lock.acquire()
+        try:
+            with self._sink:
+                self.rows = 0
+        finally:
+            self._lock.release()
+
+    def snapshot(self):
+        with self._lock:
+            with self._sink:
+                return self.rows

@@ -1,0 +1,44 @@
+# seeded GL010 violations: unguarded shared state + thread naming
+import threading
+
+
+class Counter:
+    """Spawns a worker; _total is written under _lock everywhere except
+    the racy fast-path in peek_and_reset."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._worker = threading.Thread(target=self._run,
+                                        name="mmlspark-counter",
+                                        daemon=True)
+
+    def start(self):
+        self._worker.start()
+
+    def _run(self):
+        for _ in range(100):
+            with self._lock:
+                self._total += 1
+
+    def add(self, n):
+        with self._lock:
+            self._total += n
+
+    def peek_and_reset(self):
+        seen = self._total          # unguarded read
+        self._total = 0             # unguarded write
+        return seen
+
+
+class Anonymous:
+    """Thread naming: one anonymous spawn, one off-convention name."""
+
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+        t = threading.Thread(target=self._run, name="graft-poller",
+                             daemon=True)
+        t.start()
+
+    def _run(self):
+        pass

@@ -1,0 +1,61 @@
+# clean GL010 negatives: guarded state, pre-start init, safe containers
+import queue
+import threading
+
+
+class Counter:
+    """Every post-init access to _total goes through _lock; _inbox is a
+    thread-safe queue; _done is an Event; threads carry the prefix."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._inbox = queue.Queue()
+        self._done = threading.Event()
+        self._worker = threading.Thread(target=self._run,
+                                        name="mmlspark-counter",
+                                        daemon=True)
+
+    def start(self):
+        self._worker.start()
+
+    def _run(self):
+        while not self._done.is_set():
+            item = self._inbox.get(timeout=0.1)
+            with self._lock:
+                self._total += item
+
+    def add(self, n):
+        self._inbox.put(n)
+
+    def total(self):
+        with self._lock:
+            return self._total
+
+    def close(self):
+        self._done.set()
+
+
+class NoThreads:
+    """No spawns: plain attribute access is single-threaded, no rule."""
+
+    def __init__(self):
+        self.count = 0
+
+    def bump(self):
+        self.count += 1
+
+
+class DynamicName:
+    """A computed thread name is skipped (prefix not statically known)."""
+
+    def start(self, label):
+        threading.Thread(target=self._run, name=make_name(label),
+                         daemon=True).start()
+
+    def _run(self):
+        pass
+
+
+def make_name(label):
+    return "mmlspark-" + label

@@ -1,0 +1,37 @@
+# seeded GL011 violations: condition-variable discipline
+import threading
+
+
+class Mailbox:
+    """wait() under an if (no re-test loop), notify() without the lock,
+    and an untimed wait whose close() never wakes the waiter."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []
+        self._closed = False
+        self._worker = threading.Thread(target=self._drain,
+                                        name="mmlspark-mailbox",
+                                        daemon=True)
+
+    def start(self):
+        self._worker.start()
+
+    def get_if_wait(self):
+        with self._cond:
+            if not self._items:          # wait not re-tested in a loop
+                self._cond.wait(1.0)
+            return list(self._items)
+
+    def _drain(self):
+        with self._cond:
+            while not self._items:
+                self._cond.wait()        # untimed; close() never notifies
+            self._items.clear()
+
+    def put(self, item):
+        self._items.append(item)
+        self._cond.notify()              # notify without holding the lock
+
+    def close(self):
+        self._closed = True

@@ -1,0 +1,60 @@
+# clean GL011 negatives: predicate loops, guarded notify, woken waiter
+import threading
+
+from mmlspark_tpu.core.sanitizer import san_lock
+
+
+class Mailbox:
+    """Canonical discipline: every wait re-tests its predicate in a
+    while loop, notify runs under the lock, and close() wakes the
+    untimed waiter."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []
+        self._closed = False
+        self._worker = threading.Thread(target=self._drain,
+                                        name="mmlspark-mailbox",
+                                        daemon=True)
+
+    def start(self):
+        self._worker.start()
+
+    def get(self, timeout):
+        with self._cond:
+            while not self._items and not self._closed:
+                self._cond.wait(timeout)
+            return list(self._items)
+
+    def _drain(self):
+        with self._cond:
+            while not self._items and not self._closed:
+                self._cond.wait()        # untimed, but close() notifies
+            self._items.clear()
+
+    def put(self, item):
+        with self._cond:
+            self._items.append(item)
+            self._cond.notify()
+
+    def close(self):
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+class SanBuffer:
+    """wait_for carries its own predicate; san_lock conditions count."""
+
+    def __init__(self):
+        self._cond = san_lock("fixture.san_buffer", kind="condition")
+        self._ready = False
+
+    def await_ready(self):
+        with self._cond:
+            self._cond.wait_for(lambda: self._ready, timeout=1.0)
+
+    def mark(self):
+        with self._cond:
+            self._ready = True
+            self._cond.notify_all()

@@ -1,0 +1,48 @@
+# seeded GL012 violations: blocking calls inside critical sections
+import queue
+import subprocess
+import threading
+import time
+import urllib.request
+
+_registry_lock = threading.Lock()
+_registry = {}
+
+
+def refresh_registry(url):
+    with _registry_lock:
+        body = urllib.request.urlopen(url, timeout=5.0).read()
+        _registry["raw"] = body
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inbox = queue.Queue()
+        self._worker = threading.Thread(target=self._run,
+                                        name="mmlspark-poller",
+                                        daemon=True)
+        self._results = []
+
+    def start(self):
+        self._worker.start()
+
+    def _run(self):
+        with self._lock:
+            item = self._inbox.get()     # untimed queue.get under lock
+            self._results.append(item)
+
+    def throttle(self):
+        with self._lock:
+            time.sleep(0.5)              # sleep inside critical section
+
+    def _rebuild(self):
+        subprocess.run(["make"], check=True, timeout=60)
+
+    def rebuild(self):
+        with self._lock:
+            self._rebuild()              # subprocess one helper deep
+
+    def stop(self):
+        with self._lock:
+            self._worker.join()          # untimed join under lock

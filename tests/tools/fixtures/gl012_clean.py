@@ -1,0 +1,55 @@
+# clean GL012 negatives: blocking work hoisted out of critical sections
+import queue
+import subprocess
+import threading
+import time
+import urllib.request
+
+_registry_lock = threading.Lock()
+_registry = {}
+
+
+def refresh_registry(url):
+    body = urllib.request.urlopen(url, timeout=5.0).read()
+    with _registry_lock:
+        _registry["raw"] = body
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inbox = queue.Queue()
+        self._worker = threading.Thread(target=self._run,
+                                        name="mmlspark-poller",
+                                        daemon=True)
+        self._results = []
+
+    def start(self):
+        self._worker.start()
+
+    def _run(self):
+        item = self._inbox.get(timeout=0.5)   # timed, and outside the lock
+        with self._lock:
+            self._results.append(item)
+
+    def drain_fast(self):
+        with self._lock:
+            try:
+                return self._inbox.get(False)  # non-blocking get is fine
+            except queue.Empty:
+                return None
+
+    def throttle(self):
+        time.sleep(0.5)
+
+    def rebuild(self):
+        subprocess.run(["make"], check=True, timeout=60)
+
+    def stop(self):
+        self._worker.join(timeout=5.0)         # timed join, lock-free
+        with self._lock:
+            self._results.clear()
+
+    def str_join_under_lock(self):
+        with self._lock:
+            return ",".join(str(r) for r in self._results)

@@ -60,6 +60,32 @@ def build_model(n=100_000, f=28, num_trees=100):
     return model, x
 
 
+def _san_lock_disabled_overhead_ns():
+    """Measured per-acquire cost a DISABLED san_lock with-pass adds
+    over a raw threading.Lock — the serving data plane's locks are all
+    san_lock-wrapped, so this delta rides every request. Same 200k-rep
+    protocol as bench.py's graftsan/watchdog probes; None when the
+    sanitizer is live (the guarded path is deliberately not the number
+    this field pins)."""
+    from mmlspark_tpu.core import sanitizer
+
+    if sanitizer.enabled():
+        return None
+    raw = threading.Lock()
+    wrapped = sanitizer.san_lock("bench.san_lock_probe")
+    reps = 200_000
+
+    def probe(lk):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            with lk:
+                pass
+        return (time.perf_counter() - t0) / reps * 1e9
+
+    probe(raw), probe(wrapped)  # warm
+    return round(probe(wrapped) - probe(raw), 1)
+
+
 def _percentiles(lat):
     lat = sorted(lat)
     if not lat:
@@ -171,6 +197,7 @@ def run_sustained(model, rows, clients=64, duration_s=10.0, binned="auto",
         "clients": clients, "duration_s": round(wall, 2),
         "qps": round(ok / wall, 1), "p50_ms": p50, "p99_ms": p99,
         "rejected_503": r503, "timeout_504": t504, "client_errors": errs,
+        "san_lock_disabled_overhead_ns": _san_lock_disabled_overhead_ns(),
         "model": MODEL_DESC,
     }
 
@@ -328,6 +355,7 @@ def run_elastic(model, rows, clients=16, duration_s=12.0,
         "shed_tenant": shed_tenant, "shed_priority": shed_priority,
         "rejected": rejected,
         "scale_p99_ms": scale_p99_ms,
+        "san_lock_disabled_overhead_ns": _san_lock_disabled_overhead_ns(),
         "model": MODEL_DESC,
     }
 

@@ -22,7 +22,17 @@ def all_checkers() -> List[object]:
         AccumulatorWidthChecker)
     from tools.graftlint.checkers.gl008_cross_function import (
         CrossFunctionChecker)
+    from tools.graftlint.checkers.gl009_lock_order import (
+        LockOrderChecker)
+    from tools.graftlint.checkers.gl010_unguarded_state import (
+        UnguardedStateChecker)
+    from tools.graftlint.checkers.gl011_condition_discipline import (
+        ConditionDisciplineChecker)
+    from tools.graftlint.checkers.gl012_blocking_under_lock import (
+        BlockingUnderLockChecker)
     return [CollectiveAxisChecker(), TracerHygieneChecker(),
             RecompilationChecker(), RegistryDriftChecker(),
             DeterminismChecker(), CollectiveDivergenceChecker(),
-            AccumulatorWidthChecker(), CrossFunctionChecker()]
+            AccumulatorWidthChecker(), CrossFunctionChecker(),
+            LockOrderChecker(), UnguardedStateChecker(),
+            ConditionDisciplineChecker(), BlockingUnderLockChecker()]

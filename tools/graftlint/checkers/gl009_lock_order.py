@@ -1,0 +1,107 @@
+"""GL009: lock-order inversion (potential ABBA deadlock).
+
+Builds a per-class lock-acquisition graph: every time a method (or a
+same-class helper it calls, depth ≤3) acquires lock B while holding
+lock A — via ``with self._a:`` nesting or ``.acquire()`` pairing — an
+A→B edge is recorded with its site. A cycle in that graph means two
+code paths take the same pair of locks in opposite orders: two threads
+interleaving those paths deadlock. Module-level locks participate in
+the graph too (a method that nests a module lock under an instance
+lock while another path nests them the other way is the same bug).
+
+Runtime twin: ``core/sanitizer.py``'s ``san_lock`` order recorder
+raises ``LockOrderViolation`` when an inversion actually executes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from tools.graftlint.checkers.lockmodel import (
+    Acquisition, LockTraversal, file_lock_model)
+from tools.graftlint.core import Checker, Finding, ParsedFile, Project
+
+
+class LockOrderChecker(Checker):
+    rule = "GL009"
+    name = "lock-order-inversion"
+    description = ("cycles in the per-class lock-acquisition graph "
+                   "(potential ABBA deadlocks)")
+
+    def check_file(self, pf: ParsedFile,
+                   project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        flm = file_lock_model(pf)
+        for model in flm.classes:
+            if not model.locks and not flm.mod_locks:
+                continue
+            trav = LockTraversal(model, flm.mod_locks,
+                                 flm.mod_functions)
+            for meth in model.methods.values():
+                trav.run(meth)
+            out.extend(self._find_cycles(pf, model.node.name,
+                                         trav.acquisitions))
+        return out
+
+    def _find_cycles(self, pf: ParsedFile, cls_name: str,
+                     acquisitions: List[Acquisition]) -> List[Finding]:
+        # edge (a, b): lock b acquired while a held; keep the first
+        # site per edge for attribution
+        edges: Dict[Tuple[str, str], Acquisition] = {}
+        graph: Dict[str, Set[str]] = {}
+        for acq in acquisitions:
+            for h in acq.held:
+                if h == acq.lock:
+                    continue    # reentrant re-acquire: not an order edge
+                key = (h, acq.lock)
+                edges.setdefault(key, acq)
+                graph.setdefault(h, set()).add(acq.lock)
+        out: List[Finding] = []
+        reported: Set[frozenset] = set()
+        for (a, b), acq in sorted(
+                edges.items(),
+                key=lambda kv: kv[1].node.lineno):
+            path = self._path(graph, b, a)
+            if path is None:
+                continue
+            cycle_key = frozenset(path) | {a, b}
+            if cycle_key in reported:
+                continue
+            reported.add(cycle_key)
+            # the counter-edge site: first edge along the return path
+            back = edges.get((b, path[1] if len(path) > 1 else a))
+            back_line = back.node.lineno if back else acq.node.lineno
+            chain = " -> ".join(acq.chain)
+            cycle = " -> ".join([a, b] + path[1:])
+            out.append(Finding(
+                rule=self.rule, severity="error", path=pf.rel,
+                line=acq.node.lineno, col=acq.node.col_offset,
+                message=(
+                    f"lock-order inversion in class {cls_name!r}: "
+                    f"{b!r} is acquired while holding {a!r} here "
+                    f"(via {chain}), but the opposite order "
+                    f"{cycle} closes a cycle at line {back_line} — "
+                    f"two threads interleaving these paths deadlock "
+                    f"(ABBA)"),
+                hint=("pick one global acquisition order for these "
+                      "locks and reorder the nested acquisitions (or "
+                      "merge the critical sections); the runtime twin "
+                      "is san_lock's LockOrderViolation under "
+                      "MMLSPARK_TPU_SAN=1")))
+        return out
+
+    @staticmethod
+    def _path(graph: Dict[str, Set[str]], src: str,
+              dst: str) -> List[str] | None:
+        """A simple path src -> ... -> dst in the edge graph, or None."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in sorted(graph.get(node, ())):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None

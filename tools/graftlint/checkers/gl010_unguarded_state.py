@@ -1,0 +1,154 @@
+"""GL010: unguarded shared state in thread-spawning classes.
+
+A class that spawns daemon threads (discovered by the repo's
+``mmlspark-`` thread-name prefix convention) shares its instance
+attributes between those threads and its callers. For each attribute,
+the guarding lock is inferred from the writes: when the majority of
+post-``__init__`` writes happen inside a ``with``-lock scope, the
+attribute is lock-guarded by convention — and every read or write of
+it *outside* any lock scope is a data race waiting for a chaosfuzz
+schedule. Conservative by construction: attributes only touched in
+``__init__`` (pre-``start()``), synchronization objects themselves
+(locks, queues, events, threads), and classes that spawn no threads
+are all skipped.
+
+The rule also enforces the naming convention its discovery keys off:
+every ``threading.Thread(...)`` must carry a literal
+``name="mmlspark-..."`` prefix so runtime diagnostics (watchdog
+reports, san_lock violations, leak checks) can attribute threads.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.graftlint.checkers.lockmodel import (
+    THREAD_NAME_PREFIX, ClassModel, file_lock_model,
+    with_locks_held_at)
+from tools.graftlint.core import Checker, Finding, ParsedFile, Project
+
+# attribute access sites: (attr, node, method name, is_write)
+_Access = Tuple[str, ast.AST, str, bool]
+
+# methods that run strictly before the spawned threads exist (or are
+# the constructor protocol): accesses there are pre-start by contract
+_PRE_START_METHODS = ("__init__", "__new__", "__post_init__")
+
+
+class UnguardedStateChecker(Checker):
+    rule = "GL010"
+    name = "unguarded-shared-state"
+    description = ("reads/writes of majority-lock-guarded attributes "
+                   "outside any lock scope in thread-spawning classes; "
+                   "daemon-thread naming convention")
+
+    def check_file(self, pf: ParsedFile,
+                   project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        flm = file_lock_model(pf)
+        mod_locks = flm.mod_locks
+        for model in flm.classes:
+            out.extend(self._check_thread_names(pf, model))
+            if not model.spawns_threads() or not model.locks:
+                continue
+            out.extend(self._check_attrs(pf, model, mod_locks))
+        return out
+
+    # -- thread-name convention --
+
+    def _check_thread_names(self, pf: ParsedFile,
+                            model: ClassModel) -> List[Finding]:
+        out: List[Finding] = []
+        for spawn in model.spawns:
+            if (spawn.has_name and spawn.name_prefix is not None
+                    and spawn.name_prefix.startswith(
+                        THREAD_NAME_PREFIX)):
+                continue
+            if spawn.has_name and spawn.name_prefix is None:
+                continue    # dynamic name expression: can't prove
+            what = ("has no name= argument" if not spawn.has_name else
+                    f"name does not start with "
+                    f"{THREAD_NAME_PREFIX!r}")
+            out.append(Finding(
+                rule=self.rule, severity="error", path=pf.rel,
+                line=spawn.node.lineno, col=spawn.node.col_offset,
+                message=(
+                    f"thread spawned in "
+                    f"{model.node.name}.{spawn.method} {what}: the "
+                    f"repo convention is name="
+                    f"f\"{THREAD_NAME_PREFIX}{{label}}\" and GL010's "
+                    f"thread discovery (plus watchdog/leak "
+                    f"diagnostics) keys off that prefix"),
+                hint=(f"pass name=\"{THREAD_NAME_PREFIX}<role>\" (or "
+                      f"an f-string with that literal prefix) to "
+                      f"threading.Thread")))
+        return out
+
+    # -- guarded-attribute inference --
+
+    def _check_attrs(self, pf: ParsedFile, model: ClassModel,
+                     mod_locks) -> List[Finding]:
+        accesses = self._collect_accesses(model)
+        out: List[Finding] = []
+        for attr, sites in sorted(accesses.items()):
+            if (attr in model.locks or attr in model.safe_attrs
+                    or attr in model.methods):
+                continue
+            post = [s for s in sites
+                    if s[2] not in _PRE_START_METHODS]
+            writes = [s for s in post if s[3]]
+            if not writes:
+                continue    # only written pre-start: publish-then-read
+            guarded_writes = [
+                s for s in writes
+                if with_locks_held_at(pf, s[1], model, mod_locks)]
+            if len(guarded_writes) * 2 <= len(writes):
+                continue    # no majority-guarded convention to enforce
+            guard = self._dominant_guard(pf, model, mod_locks,
+                                         guarded_writes)
+            for attr_name, node, method, is_write in post:
+                if with_locks_held_at(pf, node, model, mod_locks):
+                    continue
+                verb = "written" if is_write else "read"
+                out.append(Finding(
+                    rule=self.rule, severity="error", path=pf.rel,
+                    line=node.lineno, col=node.col_offset,
+                    message=(
+                        f"attribute 'self.{attr}' is {verb} in "
+                        f"{model.node.name}.{method} outside any lock "
+                        f"scope, but its writes are guarded by "
+                        f"{guard!r} elsewhere — "
+                        f"{model.node.name} spawns threads, so this "
+                        f"is a data race"),
+                    hint=(f"take `with self.{guard}:` around the "
+                          f"access (or make the attribute pre-start "
+                          f"immutable / move it behind a "
+                          f"queue.Queue); suppress with an inline "
+                          f"comment only for deliberate lock-free "
+                          f"reads with a stale-ok contract")))
+        return out
+
+    @staticmethod
+    def _collect_accesses(model: ClassModel) -> Dict[str, List[_Access]]:
+        accesses: Dict[str, List[_Access]] = {}
+        for mname, meth in model.methods.items():
+            for node in ast.walk(meth):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"):
+                    continue
+                is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+                accesses.setdefault(node.attr, []).append(
+                    (node.attr, node, mname, is_write))
+        return accesses
+
+    @staticmethod
+    def _dominant_guard(pf: ParsedFile, model: ClassModel, mod_locks,
+                        guarded_writes: List[_Access]) -> str:
+        counts: Dict[str, int] = {}
+        for _attr, node, _m, _w in guarded_writes:
+            for lock in with_locks_held_at(pf, node, model, mod_locks):
+                counts[lock] = counts.get(lock, 0) + 1
+        return max(sorted(counts), key=lambda k: counts[k],
+                   default="_lock")

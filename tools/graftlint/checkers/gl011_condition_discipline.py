@@ -1,0 +1,209 @@
+"""GL011: threading.Condition discipline.
+
+Three bug classes around condition variables:
+
+* ``wait()`` not re-checked in a ``while``-predicate loop — a spurious
+  wakeup (or a wakeup for a different state change) proceeds on a
+  stale predicate. The textbook rule: every ``wait()`` sits inside a
+  ``while`` that re-tests the predicate; an ``if``-guarded or bare
+  ``wait()`` checks once.
+* ``notify()``/``notify_all()`` without the condition's lock held —
+  the waiter can miss the wakeup (test-then-wait race) and CPython
+  raises ``RuntimeError`` only sometimes (after the waiter drained).
+* untimed ``wait()`` in a thread-spawning class whose
+  ``close()``/``stop()`` path never notifies that condition — shutdown
+  parks the thread forever (the leak surfaces as a hung join).
+
+``wait_for(predicate)`` is always accepted: it loops internally.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.graftlint.checkers.lockmodel import (
+    ClassModel, file_lock_model)
+from tools.graftlint.core import Checker, Finding, ParsedFile, Project
+
+_SHUTDOWN_METHODS = ("close", "stop", "shutdown", "kill", "__exit__",
+                     "__del__")
+
+
+class ConditionDisciplineChecker(Checker):
+    rule = "GL011"
+    name = "condition-discipline"
+    description = ("Condition.wait() outside a while-predicate loop, "
+                   "notify() without the lock, untimed wait() with no "
+                   "shutdown wake")
+
+    def check_file(self, pf: ParsedFile,
+                   project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for model in file_lock_model(pf).classes:
+            conds = {name for name, la in model.locks.items()
+                     if la.kind == "condition"}
+            if not conds:
+                continue
+            notifiers = self._notifying_shutdown_conds(model)
+            for mname, meth in model.methods.items():
+                for node in ast.walk(meth):
+                    call = self._cond_call(node, conds)
+                    if call is None:
+                        continue
+                    cond, op = call
+                    if op in ("wait",):
+                        out.extend(self._check_wait(
+                            pf, model, mname, meth, node, cond,
+                            notifiers))
+                    elif op in ("notify", "notify_all"):
+                        out.extend(self._check_notify(
+                            pf, model, mname, meth, node, cond, op))
+        return out
+
+    @staticmethod
+    def _cond_call(node: ast.AST, conds: Set[str]):
+        """(condition attr, method) for ``self.X.wait/notify...`` calls."""
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            return None
+        recv = node.func.value
+        if not (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"
+                and recv.attr in conds):
+            return None
+        if node.func.attr in ("wait", "notify", "notify_all"):
+            return recv.attr, node.func.attr
+        return None
+
+    # -- wait discipline --
+
+    def _check_wait(self, pf: ParsedFile, model: ClassModel,
+                    mname: str, meth: ast.AST, node: ast.Call,
+                    cond: str, notifiers: Set[str]) -> List[Finding]:
+        out: List[Finding] = []
+        has_while, if_before_while = self._loop_shape(pf, meth, node)
+        if not has_while or if_before_while:
+            shape = ("guarded by 'if', not re-checked in a 'while' "
+                     "loop" if has_while else
+                     "not inside any 'while'-predicate loop")
+            out.append(Finding(
+                rule=self.rule, severity="error", path=pf.rel,
+                line=node.lineno, col=node.col_offset,
+                message=(
+                    f"Condition.wait() on 'self.{cond}' in "
+                    f"{model.node.name}.{mname} is {shape}: a "
+                    f"spurious wakeup (or a wakeup for a different "
+                    f"state change) proceeds on a stale predicate"),
+                hint=("re-test the predicate in a while loop around "
+                      "wait() — `while not pred: cond.wait(...)` — or "
+                      "use cond.wait_for(lambda: pred, timeout=...)")))
+        if (not self._has_timeout(node)
+                and model.spawns_threads()
+                and self._has_shutdown(model)
+                and cond not in notifiers):
+            out.append(Finding(
+                rule=self.rule, severity="error", path=pf.rel,
+                line=node.lineno, col=node.col_offset,
+                message=(
+                    f"untimed Condition.wait() on 'self.{cond}' in "
+                    f"{model.node.name}.{mname}, but no "
+                    f"close()/stop() path of {model.node.name} ever "
+                    f"notifies it — shutdown parks this thread "
+                    f"forever"),
+                hint=("notify_all() the condition from the shutdown "
+                      "path after flipping the stop flag, or give "
+                      "wait() a timeout so the loop re-checks the "
+                      "flag")))
+        return out
+
+    @staticmethod
+    def _has_timeout(call: ast.Call) -> bool:
+        if call.args:
+            return not (isinstance(call.args[0], ast.Constant)
+                        and call.args[0].value is None)
+        return any(kw.arg == "timeout" and not (
+            isinstance(kw.value, ast.Constant)
+            and kw.value.value is None) for kw in call.keywords)
+
+    def _loop_shape(self, pf: ParsedFile, meth: ast.AST,
+                    node: ast.AST):
+        """(saw a While ancestor, saw an If strictly between the wait
+        and the nearest While) — walking parents inside the method."""
+        has_while = False
+        if_before_while = False
+        cur = pf.parents.get(node)
+        while cur is not None and cur is not meth:
+            if isinstance(cur, ast.While):
+                has_while = True
+                break
+            if isinstance(cur, ast.If):
+                if_before_while = True
+            cur = pf.parents.get(cur)
+        return has_while, if_before_while
+
+    # -- notify discipline --
+
+    def _check_notify(self, pf: ParsedFile, model: ClassModel,
+                      mname: str, meth: ast.AST, node: ast.Call,
+                      cond: str, op: str) -> List[Finding]:
+        cur = pf.parents.get(node)
+        while cur is not None and cur is not meth:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                for item in cur.items:
+                    expr = item.context_expr
+                    if (isinstance(expr, ast.Attribute)
+                            and isinstance(expr.value, ast.Name)
+                            and expr.value.id == "self"
+                            and expr.attr == cond):
+                        return []
+            cur = pf.parents.get(cur)
+        return [Finding(
+            rule=self.rule, severity="error", path=pf.rel,
+            line=node.lineno, col=node.col_offset,
+            message=(
+                f"{op}() on 'self.{cond}' in "
+                f"{model.node.name}.{mname} without holding the "
+                f"condition's lock (no enclosing `with self.{cond}:` "
+                f"in this method): a waiter between its predicate "
+                f"check and wait() misses this wakeup"),
+            hint=(f"wrap the state change and the {op}() in "
+                  f"`with self.{cond}:`"))]
+
+    # -- shutdown-wake discovery --
+
+    @staticmethod
+    def _has_shutdown(model: ClassModel) -> bool:
+        return any(m in model.methods for m in _SHUTDOWN_METHODS)
+
+    def _notifying_shutdown_conds(self, model: ClassModel) -> Set[str]:
+        """Condition attrs that some shutdown-path method (following
+        one level of self-calls) notifies."""
+        conds: Set[str] = set()
+        roots = [model.methods[m] for m in _SHUTDOWN_METHODS
+                 if m in model.methods]
+        seen: Set[str] = set()
+        depth = 0
+        while roots and depth <= 2:
+            next_roots = []
+            for meth in roots:
+                for node in ast.walk(meth):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)):
+                        recv = node.func.value
+                        if (node.func.attr in ("notify", "notify_all")
+                                and isinstance(recv, ast.Attribute)
+                                and isinstance(recv.value, ast.Name)
+                                and recv.value.id == "self"):
+                            conds.add(recv.attr)
+                        elif (isinstance(recv, ast.Name)
+                                and recv.id == "self"
+                                and node.func.attr in model.methods
+                                and node.func.attr not in seen):
+                            seen.add(node.func.attr)
+                            next_roots.append(
+                                model.methods[node.func.attr])
+            roots = next_roots
+            depth += 1
+        return conds

@@ -1,0 +1,176 @@
+"""GL012: blocking calls executed while a lock is held.
+
+Network I/O, untimed joins/queue-gets, sleeps and fault_point-wrapped
+I/O under a lock serialize every other thread behind one slow
+operation — the classic tail-latency amplifier for the serving data
+plane, and one hung socket away from a deadlock. The traversal tracks
+the held-lock stack through ``with``/``acquire()`` nesting and follows
+same-class helpers (depth ≤3), so a helper that opens a connection
+three frames below the critical section is still attributed to the
+lock site.
+
+``Condition.wait(...)`` on the *held* condition is exempt (it releases
+the lock while parked); timed joins/gets are exempt (bounded stall).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from tools.graftlint.checkers.lockmodel import (
+    HeldCall, LockTraversal, file_lock_model)
+from tools.graftlint.core import Checker, Finding, ParsedFile, Project
+
+# canonical dotted callables that block unboundedly (or for a network
+# round-trip) — resolved through the import map
+_BLOCKING_CALLS = {
+    "urllib.request.urlopen": "network I/O (urlopen)",
+    "urllib.request.urlretrieve": "network I/O (urlretrieve)",
+    "socket.create_connection": "network connect",
+    "socket.getaddrinfo": "DNS resolution",
+    "time.sleep": "sleep",
+    "subprocess.run": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "subprocess.check_output": "subprocess",
+}
+_FAULT_POINT_SUFFIX = "fault_point"
+
+# attribute methods that block when called with no timeout
+_UNTIMED_BLOCKERS = {
+    "join": "untimed join()",
+    "get": "untimed queue get()",
+    "recv": "socket recv()",
+    "accept": "socket accept()",
+}
+
+
+class BlockingUnderLockChecker(Checker):
+    rule = "GL012"
+    name = "blocking-under-lock"
+    description = ("network I/O, untimed joins/gets, sleeps and "
+                   "fault_point-wrapped I/O while holding a lock")
+
+    def check_file(self, pf: ParsedFile,
+                   project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        flm = file_lock_model(pf)
+        mod_locks = flm.mod_locks
+        mod_fns = flm.mod_functions
+        seen: set = set()
+        for model in flm.classes:
+            if not model.locks and not mod_locks:
+                continue
+            trav = LockTraversal(model, mod_locks, mod_fns)
+            for meth in model.methods.values():
+                trav.run(meth)
+            for hc in trav.calls:
+                f = self._finding_for(pf, model, hc)
+                if f is not None:
+                    key = (f.line, f.col, f.message)
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(f)
+        # module-level functions using module locks
+        if mod_locks:
+            trav = LockTraversal(None, mod_locks, mod_fns)
+            for fn in mod_fns.values():
+                trav.run(fn)
+            for hc in trav.calls:
+                f = self._finding_for(pf, None, hc)
+                if f is not None:
+                    key = (f.line, f.col, f.message)
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(f)
+        return out
+
+    def _finding_for(self, pf: ParsedFile, model,
+                     hc: HeldCall) -> Optional[Finding]:
+        why = self._blocking_reason(pf, model, hc)
+        if why is None:
+            return None
+        call = hc.node
+        locks = ", ".join(repr(h) for h in hc.held)
+        chain = " -> ".join(hc.chain)
+        lock_line = hc.held_nodes[-1].lineno
+        return Finding(
+            rule=self.rule, severity="error", path=pf.rel,
+            line=call.lineno, col=call.col_offset,
+            message=(
+                f"{why} while holding {locks} (acquired at line "
+                f"{lock_line}, call chain {chain}): every thread "
+                f"contending for the lock stalls behind this call"),
+            hint=("hoist the blocking call out of the critical "
+                  "section: snapshot the needed state under the "
+                  "lock, release, then do the I/O (re-validate "
+                  "after); or bound it with a timeout"))
+
+    def _blocking_reason(self, pf: ParsedFile, model,
+                         hc: HeldCall) -> Optional[str]:
+        call = hc.node
+        resolved = pf.imports.resolve_node(call.func)
+        if resolved:
+            why = _BLOCKING_CALLS.get(resolved)
+            if why:
+                return why
+            if (resolved == _FAULT_POINT_SUFFIX
+                    or resolved.endswith("." + _FAULT_POINT_SUFFIX)):
+                return self._fault_point_reason(call)
+        if isinstance(call.func, ast.Name) and \
+                call.func.id == _FAULT_POINT_SUFFIX:
+            return self._fault_point_reason(call)
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        meth = call.func.attr
+        why = _UNTIMED_BLOCKERS.get(meth)
+        if why is None:
+            return None
+        if self._has_timeout_arg(call, meth):
+            return None
+        recv = call.func.value
+        if meth == "join":
+            # zero-arg join is Thread/Process join; str.join always
+            # takes the iterable positionally
+            if call.args or call.keywords:
+                return None
+            return why
+        if meth == "get":
+            # only queue.get() blocks; dict.get/env.get never do —
+            # require the receiver to be a known queue attribute
+            if not self._is_queue_attr(model, recv):
+                return None
+            if any(isinstance(a, ast.Constant) and a.value is False
+                   for a in call.args[:1]):
+                return None    # get(False) is non-blocking
+            return why
+        # recv/accept: only on plain attribute/name receivers, to keep
+        # false positives out of dict-like .get chains
+        return why
+
+    @staticmethod
+    def _fault_point_reason(call: ast.Call) -> str:
+        label = ""
+        if call.args and isinstance(call.args[0], ast.Constant):
+            label = f" {call.args[0].value!r}"
+        return f"fault_point-wrapped I/O{label}"
+
+    @staticmethod
+    def _has_timeout_arg(call: ast.Call, meth: str) -> bool:
+        if any(kw.arg in ("timeout", "block") for kw in call.keywords):
+            return True
+        if meth == "join" and call.args:
+            return True    # join(t) — timed
+        if meth == "get" and len(call.args) >= 2:
+            return True    # get(block, timeout)
+        return False
+
+    @staticmethod
+    def _is_queue_attr(model, recv: ast.AST) -> bool:
+        if model is None:
+            return False
+        if (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"):
+            return model.safe_attrs.get(recv.attr) == "queue"
+        return False

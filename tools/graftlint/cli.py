@@ -33,7 +33,9 @@ def build_parser() -> argparse.ArgumentParser:
                     "GL003 recompilation hazards, GL004 registry "
                     "drift, GL005 determinism, GL006 collective "
                     "divergence, GL007 accumulator width, GL008 "
-                    "cross-function context)")
+                    "cross-function context, GL009 lock-order "
+                    "inversion, GL010 unguarded shared state, GL011 "
+                    "condition discipline, GL012 blocking-under-lock)")
     p.add_argument("paths", nargs="*", default=["mmlspark_tpu"],
                    help="files or directories to scan "
                         "(default: mmlspark_tpu)")
