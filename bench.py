@@ -765,6 +765,26 @@ def serving_elastic_main():
         extra={"backend_preflight": PREFLIGHT["verdict"]})
 
 
+def serving_gray_main():
+    """``python bench.py --serving-gray``: the gray-failure row — a
+    3-worker fleet with one seeded 200 ms slow worker under closed-loop
+    FleetClient load, hedging+breakers off then on; one ``serving_gray``
+    JSON row per arm (p50/p99, hedge/breaker/shed counters, measured
+    extra backend load, bitwise reply check) plus the p99-ratio summary
+    (tools/bench_serving.py emit_gray). BENCH_SERVING_CLIENTS /
+    BENCH_SERVING_DURATION_S override the load shape for rehearsals."""
+    platform = wait_for_backend(metric="serving_gray", unit="ms",
+                                allow_cpu_fallback=True)
+    print(f"# backend up: {platform}", file=sys.stderr, flush=True)
+    from mmlspark_tpu.core.compile_cache import enable_persistent_cache
+    enable_persistent_cache()
+    from tools.bench_serving import emit_gray
+    emit_gray(
+        clients=int(os.environ.get("BENCH_SERVING_CLIENTS", 8)),
+        duration_s=float(os.environ.get("BENCH_SERVING_DURATION_S", 8)),
+        extra={"backend_preflight": PREFLIGHT["verdict"]})
+
+
 def serving_sustained_main():
     """``python bench.py --serving-sustained``: the serving-path row —
     64 keep-alive clients for a fixed duration against the generic
@@ -790,6 +810,8 @@ if __name__ == "__main__":
         serving_elastic_main()
     elif "--serving-sustained" in sys.argv:
         serving_sustained_main()
+    elif "--serving-gray" in sys.argv:
+        serving_gray_main()
     elif "--refresh-under-load" in sys.argv:
         refresh_under_load_main()
     elif "--refresh-latency" in sys.argv:

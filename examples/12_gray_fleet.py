@@ -1,0 +1,133 @@
+"""Gray-failure-tolerant request plane end to end: hedged requests
+around a slow-not-dead worker, deadline propagation with attributed
+load-shedding, a half-open-connection chaos drill, and the
+supervisor's gray-outlier recycle.
+
+A small GBDT serves behind a three-worker ``ServingFleet``; one worker
+goes gray — alive, heartbeat-passing, 50x slower than its peers (a
+congested NIC, a throttled host). The hedging ``FleetClient`` fires a
+backup attempt at a sibling once a request is unanswered past its
+adaptive delay, so every reply stays fast AND bitwise-identical to the
+healthy-fleet reference; after two over-threshold latency samples the
+client ejects the gray worker from rotation outright. A request
+arriving with its deadline budget already spent is shed AT DEQUEUE
+with an attributed 504 — never scored — while in-budget traffic keeps
+flowing. An armed ``net.half_open`` stall (connection accepted, then
+nothing) is covered by the hedge well inside the stall. Finally the
+``FleetSupervisor`` recycles the gray worker: its ``/healthz`` p99
+stays a factor above the fleet median for the streak, so it is
+deregistered, drained, stopped, and respawned fresh.
+"""
+import _common
+
+_common.setup()
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from mmlspark_tpu.core import faults
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.io.fleet import FleetSupervisor
+from mmlspark_tpu.io.serving import FleetClient, ServingFleet
+from mmlspark_tpu.models.gbdt.estimators import LightGBMRegressor
+
+N, F = 800, 6
+
+
+def _post(url, payload, headers=None, timeout=10.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _health(server):
+    with urllib.request.urlopen(
+            f"http://{server.host}:{server.port}/healthz", timeout=5) as r:
+        return json.loads(r.read())
+
+
+def main():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(N, F))
+    y = X @ rng.normal(size=F) + 0.1 * rng.normal(size=N)
+    model = LightGBMRegressor(numIterations=10, numLeaves=15, maxBin=31,
+                              seed=7).fit(
+        DataFrame({"features": X, "label": y}))
+    row = {"features": X[0].tolist()}
+
+    fleet = ServingFleet(model, num_servers=3, max_latency_ms=2.0).start()
+    client = FleetClient(fleet.registry_url, timeout=10.0, hedging=True,
+                         deadline_ms=8000.0, hedge_delay_ms=25.0)
+    reference = client.score(dict(row))  # healthy-fleet reply
+    print(f"fleet up: 3 workers, reference prediction "
+          f"{reference['prediction']:.6f}")
+
+    # -- 1. one worker goes gray; hedging keeps the tail flat ---------------
+    gray = fleet.servers[0]
+    gray.gray_delay_ms = 150.0  # alive, heartbeat-passing, 50x slower
+    t0 = time.monotonic()
+    for _ in range(24):
+        assert client.score(dict(row)) == reference  # bitwise, every time
+    elapsed = time.monotonic() - t0
+    s = client.stats
+    print(f"24 requests through the gray fleet in {elapsed * 1e3:.0f} ms: "
+          f"{s['hedges_fired']} hedges fired, {s['hedges_won']} won, "
+          f"{s['slow_ejections']} slow ejection(s) — replies bitwise")
+    assert s["hedges_won"] >= 1 and s["slow_ejections"] >= 1
+    assert elapsed < 24 * 0.150  # faster than one gray score per request
+
+    # -- 2. deadline propagation: 0-budget request shed at dequeue ----------
+    fast = fleet.servers[1]
+    try:
+        _post(fast.url, dict(row), headers={"X-Deadline-Ms": "0"})
+        raise AssertionError("0-budget request was served")
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read())
+        assert e.code == 504 and body["shed"] == "deadline"
+        print(f"0-budget request shed at dequeue: 504 "
+              f"{body['error']!r} (never scored)")
+    assert _health(fast)["shed_deadline"] == 1
+    assert _post(fast.url, dict(row),
+                 headers={"X-Deadline-Ms": "5000"}) == reference
+    print("in-budget request behind it completed, reply bitwise")
+
+    # -- 3. half-open connection chaos drill --------------------------------
+    faults.arm("net.half_open", "delay", delay_s=1.5, count=1)
+    t0 = time.monotonic()
+    covered = client.score(dict(row))  # primary stalls; hedge covers
+    elapsed = time.monotonic() - t0
+    faults.reset()
+    assert covered == reference and elapsed < 1.2
+    print(f"half-open stall (1.5 s) covered by the hedge in "
+          f"{elapsed * 1e3:.0f} ms")
+
+    # -- 4. supervisor recycles the gray outlier ----------------------------
+    for srv in list(fleet.servers):  # every worker needs p99 samples
+        for _ in range(3):
+            _post(srv.url, dict(row))
+    sup = FleetSupervisor(fleet, min_workers=3, max_workers=3,
+                          gray_factor=3.0, gray_min_p99_ms=20.0,
+                          gray_streak=2, drain_timeout_s=5.0)
+    sup.tick()  # streak 1: hysteresis — one bad sweep is not gray
+    sup.tick()  # streak 2: recycle
+    stats = sup.stats()
+    assert stats["gray_recycles"] == 1 and stats["deaths"] == 0
+    assert gray not in fleet.servers and len(fleet.worker_urls) == 3
+    print(f"supervisor recycled the gray worker (p99 outlier, "
+          f"heartbeats passing): fleet back to {stats['workers']} "
+          f"workers, {stats['gray_recycles']} gray recycle")
+    client.refresh()
+    assert client.score(dict(row)) == reference  # respawn serves bitwise
+    sup.stop()
+    fleet.stop()
+    print("OK 12_gray_fleet")
+
+
+if __name__ == "__main__":
+    main()

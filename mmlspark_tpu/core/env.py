@@ -249,6 +249,30 @@ SERVE_TENANT_BURST = register(
     "serving admission control: per-tenant token-bucket capacity "
     "(burst size); an over-budget tenant sheds with 503 + Retry-After "
     "without dragging other tenants' p99")
+REQUEST_DEADLINE_MS = register(
+    "MMLSPARK_TPU_REQUEST_DEADLINE_MS", "float", 0.0,
+    "gray-failure tolerance: end-to-end request budget in ms that "
+    "FleetClient stamps as the X-Deadline-Ms header; the remaining "
+    "budget rides the queue and the server sheds already-expired "
+    "requests at dequeue with an attributed 504 before scoring "
+    "(0 = no deadline propagation)")
+HEDGE_DELAY_MS = register(
+    "MMLSPARK_TPU_HEDGE_DELAY_MS", "float", 30.0,
+    "gray-failure tolerance: floor in ms on FleetClient's adaptive "
+    "hedge delay (rolling per-worker p95); after the delay without a "
+    "reply the request is hedged on a second worker and the first "
+    "reply wins")
+HEDGE_BUDGET_PCT = register(
+    "MMLSPARK_TPU_HEDGE_BUDGET_PCT", "float", 5.0,
+    "gray-failure tolerance: hedge token bucket — hedged requests may "
+    "add at most this percentage of extra backend load (a hedge costs "
+    "one token; tokens accrue per primary request)")
+RETRY_BUDGET_PCT = register(
+    "MMLSPARK_TPU_RETRY_BUDGET_PCT", "float", 10.0,
+    "gray-failure tolerance: global FleetClient retry token bucket as "
+    "a percentage of request volume; once drained (fleet-wide "
+    "brownout) further retries shed to the caller with attribution "
+    "instead of amplifying the overload")
 BENCH_PROBE_TIMEOUT_S = register(
     "MMLSPARK_TPU_BENCH_PROBE_TIMEOUT_S", "int", 90,
     "bench.py: seconds per TPU backend probe attempt")

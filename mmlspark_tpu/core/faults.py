@@ -129,6 +129,23 @@ KNOWN_POINTS: Dict[str, str] = {
                   "bit-rot, which the crc32 check must catch and "
                   "either repair from the source chunk iterator or "
                   "raise an attributed SpillCorrupt",
+    "net.latency": "FleetClient outbound socket layer "
+                   "(FleetClient._post) — an armed delay is network "
+                   "RTT inflation / a slow connect, an armed raise a "
+                   "dropped connection; hedging + breakers must keep "
+                   "tail latency bounded",
+    "net.half_open": "ServingServer request handler entry — an armed "
+                     "delay means the worker ACCEPTED the connection "
+                     "then stalls before reading or replying (a "
+                     "half-open connection); clients must fail over "
+                     "within their deadline instead of hanging, an "
+                     "armed raise tears the connection down with no "
+                     "HTTP reply",
+    "net.slow_reply": "ServingServer reply write path — an armed "
+                      "delay is a gray worker whose replies crawl out "
+                      "(headers/body stall) while heartbeats still "
+                      "pass; the supervisor's p99-outlier detection "
+                      "must classify it gray-degraded and recycle it",
 }
 
 _VALID_ACTIONS = ("raise", "delay", "corrupt")

@@ -16,13 +16,15 @@ that quietly fall back don't mislabel A/B measurements.
 from __future__ import annotations
 
 import random
+import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence, Tuple, Type
 
 from mmlspark_tpu.core.logging_utils import logger, warn_once
 
-__all__ = ["RetryPolicy", "with_retries", "backoff_schedule"]
+__all__ = ["RetryPolicy", "with_retries", "backoff_schedule",
+           "CircuitBreaker", "FractionBudget"]
 
 
 @dataclass(frozen=True)
@@ -45,13 +47,121 @@ class RetryPolicy:
         return d * (1.0 + self.jitter * rng.random())
 
 
-def backoff_schedule(delays: Sequence[float]) -> RetryPolicy:
+def backoff_schedule(delays: Sequence[float],
+                     deadline: Optional[float] = None) -> RetryPolicy:
     """Adapt an explicit delay list (the ``backoffs`` param surface of
     the HTTP transformers) onto a policy: attempts = len+1, and
-    ``with_retries`` consults the list verbatim via ``fixed_delays``."""
-    policy = RetryPolicy(max_attempts=len(delays) + 1, jitter=0.0)
+    ``with_retries`` consults the list verbatim via ``fixed_delays``.
+    ``deadline`` bounds the TOTAL retry span in seconds from the first
+    attempt — without it a long backoff list can exceed the caller's
+    own per-request budget (the concurrentTimeout contract)."""
+    policy = RetryPolicy(max_attempts=len(delays) + 1, jitter=0.0,
+                         deadline=deadline)
     object.__setattr__(policy, "_fixed", tuple(float(d) for d in delays))
     return policy
+
+
+class CircuitBreaker:
+    """Per-target circuit breaker: ``failure_threshold`` CONSECUTIVE
+    errors/timeouts open the circuit — further calls are skipped
+    outright (no connect) for ``open_s`` seconds, after which ONE
+    half-open probe is admitted; its success closes the circuit, its
+    failure re-opens for another ``open_s``. Thread-safe; callers pair
+    each admitted call with :meth:`record_success` or
+    :meth:`record_failure`."""
+
+    __slots__ = ("failure_threshold", "open_s", "_lock", "_state",
+                 "_failures", "_opened_t", "_probing")
+
+    def __init__(self, failure_threshold: int = 3, open_s: float = 2.0):
+        self.failure_threshold = max(int(failure_threshold), 1)
+        self.open_s = open_s
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._failures = 0
+        self._opened_t = 0.0
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """True when a call may proceed. While open, returns False
+        until ``open_s`` elapsed; then transitions to half-open and
+        admits exactly one probe (concurrent callers keep skipping
+        until that probe resolves)."""
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if time.monotonic() - self._opened_t < self.open_s:
+                    return False
+                self._state = "half-open"
+                self._probing = True
+                return True
+            # half-open: one probe in flight owns the circuit
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._state = "closed"
+            self._failures = 0
+            self._probing = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == "half-open":
+                # failed probe: straight back to open, fresh window
+                self._state = "open"
+                self._opened_t = time.monotonic()
+                self._probing = False
+                return
+            self._failures += 1
+            if self._failures >= self.failure_threshold:
+                self._state = "open"
+                self._opened_t = time.monotonic()
+
+
+class FractionBudget:
+    """Token bucket expressed as a FRACTION of primary traffic: every
+    :meth:`note_request` accrues ``pct/100`` tokens (capped at
+    ``burst``) and each :meth:`take` spends one — the mechanism behind
+    both the FleetClient hedge budget (extra backend load stays under
+    ``pct``%) and its global retry budget (a fleet-wide brownout stops
+    amplifying once retries outrun ``pct``% of request volume).
+    Thread-safe."""
+
+    __slots__ = ("pct", "burst", "_lock", "_tokens", "noted", "taken",
+                 "denied")
+
+    def __init__(self, pct: float, burst: float = 8.0):
+        self.pct = max(float(pct), 0.0)
+        self.burst = max(float(burst), 1.0)
+        self._lock = threading.Lock()
+        self._tokens = self.burst
+        self.noted = 0
+        self.taken = 0
+        self.denied = 0
+
+    def note_request(self) -> None:
+        with self._lock:
+            self.noted += 1
+            self._tokens = min(self.burst,
+                               self._tokens + self.pct / 100.0)
+
+    def take(self) -> bool:
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self.taken += 1
+                return True
+            self.denied += 1
+            return False
 
 
 def with_retries(fn: Callable, *, policy: Optional[RetryPolicy] = None,

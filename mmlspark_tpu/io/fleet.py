@@ -27,11 +27,19 @@ error path. :class:`FleetSupervisor` closes that loop over
     (clients stop discovering it), then
     :meth:`~mmlspark_tpu.io.serving.ServingServer.drain` flushes every
     already-accepted request, then the worker stops: zero accepted
-    requests are lost.
+    requests are lost;
+  - **gray-failure detection** — a worker can pass every heartbeat and
+    still serve at 50x latency (a *gray* failure: slow, not dead). A
+    worker whose rolling ``/healthz`` p99 exceeds ``gray_factor`` times
+    the median of its peers (and an absolute ``gray_min_p99_ms`` floor)
+    for ``gray_streak`` consecutive sweeps is classified gray-degraded
+    and recycled: deregistered, drained, stopped — convergence then
+    respawns a fresh worker (``gray_recycles`` in :meth:`stats`).
 
 The chaos contract (``fleet.heartbeat`` / ``fleet.spawn`` /
-``serving.worker_kill`` in ``core/faults.py``) and
-tests/io/test_fleet_elastic.py pin all four behaviors.
+``serving.worker_kill`` / ``net.slow_reply`` in ``core/faults.py``) and
+tests/io/test_fleet_elastic.py + tests/io/test_net_gray.py pin these
+behaviors.
 """
 
 from __future__ import annotations
@@ -81,7 +89,10 @@ class FleetSupervisor:
                  queue_low_frac: float = 0.05,
                  drain_timeout_s: float = 10.0,
                  probe_timeout_s: Optional[float] = None,
-                 spawn_policy: Optional[RetryPolicy] = None):
+                 spawn_policy: Optional[RetryPolicy] = None,
+                 gray_factor: float = 4.0,
+                 gray_min_p99_ms: float = 50.0,
+                 gray_streak: int = 3):
         self.fleet = fleet
         self.min_workers = (min_workers if min_workers is not None
                             else env_int(FLEET_MIN, 1, minimum=1))
@@ -116,6 +127,12 @@ class FleetSupervisor:
         # decisions move it inside [min, max]
         self.target = min(max(len(fleet.worker_urls), self.min_workers),
                           self.max_workers)
+        # gray-failure detection thresholds: a heartbeat-PASSING worker
+        # whose p99 is a clear outlier vs its peers is slow-not-dead
+        self.gray_factor = gray_factor
+        self.gray_min_p99_ms = gray_min_p99_ms
+        self.gray_streak = max(int(gray_streak), 1)
+        self._gray_streaks: Dict[int, int] = {}  # id(server) -> streak
         self._misses: Dict[int, int] = {}  # id(server) -> missed beats
         self._up_streak = 0
         self._down_streak = 0
@@ -123,7 +140,7 @@ class FleetSupervisor:
         self._stats = {"heartbeats": 0, "deaths": 0, "spawns": 0,
                        "scale_ups": 0, "scale_downs": 0, "drained": 0,
                        "spawn_failures": 0, "fleet_swaps": 0,
-                       "fleet_swap_rollbacks": 0}
+                       "fleet_swap_rollbacks": 0, "gray_recycles": 0}
         # (t_monotonic, n_workers) after every pass — the worker-count
         # trajectory the serving_elastic bench row reports
         self.history: List[Tuple[float, int]] = []
@@ -145,12 +162,13 @@ class FleetSupervisor:
         except Exception:
             return None
 
-    def _sweep(self) -> List[Dict[str, Any]]:
+    def _sweep(self) -> List[Tuple[ServingServer, Dict[str, Any]]]:
         """Heartbeat every worker; evict + stop the dead. Returns the
-        health snapshots of the live ones (autoscaler input)."""
+        live workers with their health snapshots (autoscaler + gray
+        detection input)."""
         with self.fleet._servers_lock:
             servers = list(self.fleet.servers)
-        healths: List[Dict[str, Any]] = []
+        healths: List[Tuple[ServingServer, Dict[str, Any]]] = []
         live_ids = set()
         for server in servers:
             self._stats["heartbeats"] += 1
@@ -158,7 +176,7 @@ class FleetSupervisor:
             live_ids.add(id(server))
             if health is not None:
                 self._misses[id(server)] = 0
-                healths.append(health)
+                healths.append((server, health))
                 continue
             misses = self._misses.get(id(server), 0) + 1
             self._misses[id(server)] = misses
@@ -179,7 +197,62 @@ class FleetSupervisor:
         # forget miss counts of evicted workers (id() values recycle)
         self._misses = {k: v for k, v in self._misses.items()
                         if k in live_ids}
+        self._gray_streaks = {k: v for k, v in self._gray_streaks.items()
+                              if k in live_ids}
         return healths
+
+    # -- gray-failure detection ----------------------------------------------
+    def _gray_sweep(
+            self,
+            healths: List[Tuple[ServingServer, Dict[str, Any]]]
+    ) -> "set[int]":
+        """Classify heartbeat-passing p99 outliers as gray-degraded and
+        recycle them: a worker ``gray_factor``x slower (rolling p99)
+        than the MEDIAN of its peers — and past the absolute
+        ``gray_min_p99_ms`` floor — for ``gray_streak`` consecutive
+        sweeps is slow-not-dead (``net.slow_reply`` territory: it
+        answers every heartbeat). Recycle = deregister first (clients
+        stop discovering it), drain what it already accepted, stop;
+        :meth:`_converge` then respawns a fresh worker. Returns the
+        recycled ``id(server)`` set so the caller can keep the outlier's
+        p99 out of the scaling decision."""
+        p99s = {id(s): h.get("p99_ms") for s, h in healths}
+        victims: List[ServingServer] = []
+        for server, health in healths:
+            p99 = health.get("p99_ms")
+            peers = [v for k, v in p99s.items()
+                     if k != id(server) and v is not None]
+            if p99 is None or not peers:
+                self._gray_streaks[id(server)] = 0
+                continue
+            median = sorted(peers)[len(peers) // 2]
+            gray = (p99 > self.gray_factor * max(median, 1e-9)
+                    and p99 > self.gray_min_p99_ms)
+            if not gray:
+                self._gray_streaks[id(server)] = 0
+                continue
+            streak = self._gray_streaks.get(id(server), 0) + 1
+            self._gray_streaks[id(server)] = streak
+            if streak >= self.gray_streak:
+                victims.append(server)
+        for server in victims:
+            logger.warning(
+                "fleet worker %s:%s is gray-degraded (p99=%s ms vs "
+                "fleet median; heartbeats still passing); recycling",
+                server.host, server.port,
+                p99s.get(id(server)))
+            self.fleet.remove_worker(server)
+            self._gray_streaks.pop(id(server), None)
+            self._misses.pop(id(server), None)
+            self._stats["gray_recycles"] += 1
+            try:
+                server.drain(timeout_s=self.drain_timeout_s)
+                server.stop()
+            except Exception:  # teardown is best-effort
+                logger.exception(
+                    "gray recycle teardown failed on %s:%s",
+                    server.host, server.port)
+        return {id(s) for s in victims}
 
     # -- membership ----------------------------------------------------------
     def _spawn(self) -> bool:
@@ -351,11 +424,14 @@ abort_swap`; nothing was flipped, so the old model never stopped
                 break  # at min_workers floor; nothing retired
 
     def tick(self) -> None:
-        """One full supervision pass: heartbeat sweep -> scaling
-        decision -> converge membership. The loop is just this on a
-        timer; tests call it directly for determinism."""
+        """One full supervision pass: heartbeat sweep -> gray-outlier
+        recycle -> scaling decision -> converge membership. The loop is
+        just this on a timer; tests call it directly for determinism."""
         healths = self._sweep()
-        self._decide(healths)
+        recycled = self._gray_sweep(healths)
+        # a recycled outlier's p99 must not ALSO trigger a scale-up:
+        # its replacement arrives via convergence, not via target bump
+        self._decide([h for s, h in healths if id(s) not in recycled])
         self._converge()
         self.history.append((time.monotonic(),
                              len(self.fleet.worker_urls)))

@@ -92,8 +92,11 @@ def _execute_one(request: Dict[str, Any], timeout: float,
         return True  # connection errors / timeouts / injected faults
 
     try:
+        # the caller's concurrentTimeout is the TOTAL budget, not a
+        # per-attempt one: passed as the retry deadline so the backoff
+        # loop cannot outlive the request's own budget
         return with_retries(
-            attempt, policy=backoff_schedule(backoffs),
+            attempt, policy=backoff_schedule(backoffs, deadline=timeout),
             should_retry=should_retry,
             min_delay_override=_retry_after_floor,
             describe="http.request")

@@ -75,6 +75,7 @@ deregister), so scale-down loses zero accepted requests.
 from __future__ import annotations
 
 import json
+import queue as queue_lib
 import socket
 import threading
 import time
@@ -88,6 +89,10 @@ import numpy as np
 from mmlspark_tpu.core import sanitizer
 from mmlspark_tpu.core.dataframe import DataFrame
 from mmlspark_tpu.core.env import (
+    HEDGE_BUDGET_PCT,
+    HEDGE_DELAY_MS,
+    REQUEST_DEADLINE_MS,
+    RETRY_BUDGET_PCT,
     SERVE_BINNED,
     SERVE_BUCKETS,
     SERVE_MODEL_QUEUE,
@@ -101,6 +106,7 @@ from mmlspark_tpu.core.env import (
 from mmlspark_tpu.core.faults import fault_point
 from mmlspark_tpu.core.logging_utils import logger, warn_once
 from mmlspark_tpu.core.pipeline import Transformer
+from mmlspark_tpu.core.retries import CircuitBreaker, FractionBudget
 
 
 class _CappedThreadingHTTPServer(ThreadingHTTPServer):
@@ -189,7 +195,8 @@ class _CappedThreadingHTTPServer(ThreadingHTTPServer):
 
 
 class _Pending:
-    __slots__ = ("payload", "event", "reply", "error", "binned", "t0")
+    __slots__ = ("payload", "event", "reply", "error", "binned", "t0",
+                 "deadline", "tenant")
 
     def __init__(self, payload):
         self.payload = payload
@@ -198,6 +205,11 @@ class _Pending:
         self.error = None
         self.binned = None  # pre-binned (F,) row, set on request threads
         self.t0 = time.monotonic()  # admission time, for service p99
+        # absolute monotonic deadline from the X-Deadline-Ms budget the
+        # client stamped (None = no deadline rides this request); the
+        # batch loop sheds expired requests at dequeue before scoring
+        self.deadline: Optional[float] = None
+        self.tenant = "default"  # for attributing a deadline shed
 
 
 class _TokenBucket:
@@ -357,7 +369,7 @@ class _ServedModel:
                       "cold_rebuilds": 0, "evictions": 0,
                       "swaps": 0, "swap_rollbacks": 0,
                       "admitted": 0, "shed_tenant": 0,
-                      "shed_priority": 0}
+                      "shed_priority": 0, "shed_deadline": 0}
         # rolling (t_done, lat_ms) service latencies (admission ->
         # reply) feeding the /healthz p50/p99 the autoscaler reads
         self.latencies: deque = deque(maxlen=1024)
@@ -450,8 +462,13 @@ class ServingServer:
         self._stats = {"served": 0, "errors": 0, "rejected": 0,
                        "timeouts": 0, "swaps": 0, "swap_rollbacks": 0,
                        "admitted": 0, "shed_tenant": 0,
-                       "shed_priority": 0, "log_rows": 0,
-                       "log_tap_errors": 0}
+                       "shed_priority": 0, "shed_deadline": 0,
+                       "log_rows": 0, "log_tap_errors": 0}
+        # sustained gray-worker throttle (drills, benches, chaosfuzz):
+        # every scored batch sleeps this long BEFORE replying, so the
+        # worker stays heartbeat-alive while its /healthz p99 inflates
+        # — the signal FleetSupervisor's gray detection keys on
+        self.gray_delay_ms = 0.0
         self._last_shed = 0.0  # monotonic time of the last 503
         self._last_binned_fallback = 0.0
         # model-name -> degradation reason while a hot-swap is running
@@ -508,6 +525,12 @@ class ServingServer:
                 self.send_error(404)
 
             def do_POST(self):
+                # chaos boundary: armed delay = the worker ACCEPTED the
+                # connection then stalls before reading or replying (a
+                # half-open connection); armed raise tears the
+                # connection down with no HTTP reply at all — either
+                # way the client must fail over within its deadline
+                fault_point("net.half_open")
                 if server._draining:
                     # graceful retirement: stop accepting, flush what
                     # was already admitted — a retiring worker turns
@@ -558,6 +581,17 @@ class ServingServer:
                          str(max(int(server.retry_after_s), 1))})
                     return
                 pending = _Pending(payload)
+                pending.tenant = tenant
+                # deadline propagation: the client's remaining budget
+                # rides the queue; the batch loop sheds it at dequeue
+                # (attributed 504) once expired, before wasting a score
+                hdr = self.headers.get("X-Deadline-Ms")
+                if hdr is not None:
+                    try:
+                        pending.deadline = \
+                            pending.t0 + float(hdr) / 1000.0
+                    except ValueError:
+                        pass  # malformed budget = no deadline
                 plane = served.plane
                 if plane is not None:
                     # pre-bin on the request thread: the scoring thread
@@ -576,16 +610,34 @@ class ServingServer:
                         {"Retry-After":
                          str(max(int(server.retry_after_s), 1))})
                     return
-                if not pending.event.wait(
-                        timeout=server.request_timeout_s):
+                # the request's own budget replaces the flat
+                # request_timeout_s wait: a deadline-carrying request
+                # waits only (remaining + grace) for the batch loop to
+                # dequeue-and-shed it, never the full server timeout
+                wait_s = server.request_timeout_s
+                if pending.deadline is not None:
+                    wait_s = min(wait_s, max(
+                        pending.deadline - time.monotonic(), 0.0)
+                        + server._deadline_grace_s)
+                if not pending.event.wait(timeout=wait_s):
+                    expired = (pending.deadline is not None
+                               and time.monotonic() >= pending.deadline)
                     with server._lock:
-                        server._stats["timeouts"] += 1
-                        served.stats["timeouts"] += 1
                         # a timed-out request still sitting in the
                         # queue must not consume a scoring slot
                         if pending in served.queue:
                             served.queue.remove(pending)
-                    self.send_error(504, "scoring timed out")
+                        if expired:
+                            server._count_deadline_shed(served, tenant)
+                        else:
+                            server._stats["timeouts"] += 1
+                            served.stats["timeouts"] += 1
+                    if expired:
+                        self._reply_json(
+                            504, server._deadline_body(
+                                pending, served, tenant))
+                    else:
+                        self.send_error(504, "scoring timed out")
                     return
                 if pending.error is not None:
                     if pending.error in ("server stopped",
@@ -597,10 +649,20 @@ class ServingServer:
                             503, {"error": pending.error},
                             {"Retry-After":
                              str(max(int(server.retry_after_s), 1))})
+                    elif pending.error.startswith("deadline exceeded"):
+                        # shed at dequeue: the 504 is attributed (who,
+                        # which model, how overdue) so a deadline miss
+                        # is never a silent timeout
+                        self._reply_json(
+                            504, server._deadline_body(
+                                pending, served, tenant))
                     else:
                         self.send_error(500, pending.error)
                     return
                 body = json.dumps(pending.reply).encode()
+                # chaos boundary: a gray worker whose replies crawl
+                # out — the headers stall while heartbeats keep passing
+                fault_point("net.slow_reply")
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -660,6 +722,10 @@ class ServingServer:
     _MAX_TENANTS = 256
     # rolling window for the /healthz p50/p99 the autoscaler reads
     _latency_window_s = 30.0
+    # extra wait past a request's own deadline before the handler gives
+    # up on the batch loop shedding it at dequeue — covers one batch
+    # window so the dequeue path (attributed, counted) usually wins
+    _deadline_grace_s = 0.25
 
     def _tenant_counters(self, served: _ServedModel,
                          tenant: str) -> Dict[str, int]:
@@ -669,9 +735,36 @@ class ServingServer:
                     and len(served.tenants) >= self._MAX_TENANTS):
                 return self._tenant_counters(served, "__other__")
             counters = {"admitted": 0, "shed_tenant": 0,
-                        "shed_priority": 0}
+                        "shed_priority": 0, "shed_deadline": 0}
             served.tenants[tenant] = counters
         return counters
+
+    def _count_deadline_shed(self, served: _ServedModel,
+                             tenant: str) -> None:
+        """Attribute one deadline shed (caller holds the lock): the
+        per-model and per-tenant ``shed_deadline`` counters surface in
+        ``/healthz`` so an expired budget is a measured event, not a
+        silent timeout."""
+        self._stats["shed_deadline"] += 1
+        served.stats["shed_deadline"] += 1
+        self._tenant_counters(served, tenant)["shed_deadline"] += 1
+        # both call sites (handler timeout path, batch-loop dequeue)
+        # hold self._lock per this helper's contract
+        self._last_shed = time.monotonic()  # graftlint: disable=GL010
+
+    @staticmethod
+    def _deadline_body(pending: _Pending, served: _ServedModel,
+                       tenant: str) -> Dict[str, Any]:
+        """Attributed 504 payload for a deadline shed."""
+        overdue_ms = (time.monotonic() - pending.deadline) * 1e3 \
+            if pending.deadline is not None else 0.0
+        reason = pending.error if (
+            pending.error or "").startswith("deadline exceeded") else (
+            f"deadline exceeded: request budget spent "
+            f"{max(overdue_ms, 0.0):.0f} ms ago while queued; shed "
+            f"before scoring")
+        return {"error": reason, "model": served.name,
+                "tenant": tenant, "shed": "deadline"}
 
     def _admit(self, served: _ServedModel, tenant: str,
                priority: str) -> Optional[str]:
@@ -1301,8 +1394,28 @@ FleetSupervisor` notices via missed heartbeats and respawns."""
                         deadline - time.monotonic(), 0.0))
                 batch = served.queue[:self.max_batch_size]
                 del served.queue[:len(batch)]
+                # deadline shed at dequeue: a request whose budget
+                # expired while queued gets an attributed 504 BEFORE
+                # wasting a scoring slot — the batch scores only
+                # requests that can still make their deadline
+                expired: List[_Pending] = []
+                if batch:
+                    now = time.monotonic()
+                    live = []
+                    for p in batch:
+                        if p.deadline is not None and p.deadline <= now:
+                            expired.append(p)
+                            self._count_deadline_shed(served, p.tenant)
+                        else:
+                            live.append(p)
+                    batch = live
                 if batch:
                     self._inflight_batches += 1
+            for p in expired:
+                p.error = ("deadline exceeded: request budget spent "
+                           "while queued; shed at dequeue before "
+                           "scoring")
+                p.event.set()
             if not batch:  # all requests timed out during the wait
                 continue
             try:
@@ -1364,6 +1477,11 @@ FleetSupervisor` notices via missed heartbeats and respawns."""
         # here simulates a slow model (queue backs up -> 503s), a raise
         # simulates a failing one (500s surface to callers)
         fault_point("serving.score")
+        if self.gray_delay_ms > 0.0:
+            # sustained gray throttle (see __init__): inside the
+            # measured admission->reply window, so /healthz p99 carries
+            # the signal the supervisor's outlier detection reads
+            time.sleep(self.gray_delay_ms / 1000.0)
         if served is None:
             served = self._models[self._default]
         keep_id = served.keep_id
@@ -1627,7 +1745,33 @@ class FleetClient:
     discoverable, and this client round-robins across them, retrying a
     failed request on the next worker (the serving-path analog of
     FaultToleranceUtils.retryWithTimeout,
-    core/utils/FaultToleranceUtils.scala:9-31)."""
+    core/utils/FaultToleranceUtils.scala:9-31).
+
+    Gray-failure tolerance (the arXiv:1605.08695 §4 hedging playbook —
+    real fleets mostly fail *slow*, not dead):
+
+      - **deadline propagation** — with ``deadline_ms`` set (default
+        ``MMLSPARK_TPU_REQUEST_DEADLINE_MS``), every attempt stamps the
+        REMAINING budget as the ``X-Deadline-Ms`` header; the server
+        sheds expired requests at dequeue with an attributed 504, and
+        the client stops retrying once the budget is spent;
+      - **hedged requests** (``hedging=True``) — when the primary has
+        not replied within an adaptive delay (rolling per-worker p95,
+        floor ``MMLSPARK_TPU_HEDGE_DELAY_MS``), the same idempotent
+        request fires at a second worker and the first reply wins (the
+        loser is counted cancelled); a token bucket caps hedges at
+        ``MMLSPARK_TPU_HEDGE_BUDGET_PCT``% extra backend load, and a
+        worker whose rolling p95 is an outlier vs its peers is ejected
+        from rotation like a degraded one (``slow_ejections``);
+      - **per-worker circuit breakers** — consecutive connection
+        errors/timeouts open a breaker: the worker is skipped outright
+        (no connect) until a half-open probe re-admits it;
+      - **global retry budget** — retries draw from a
+        ``MMLSPARK_TPU_RETRY_BUDGET_PCT``%-of-traffic token bucket, so
+        a fleet-wide brownout sheds retries to the caller (attributed
+        ``retry budget exhausted``) instead of amplifying the overload.
+
+    Counters for all of it live in :attr:`stats`."""
 
     # floor between re-discoveries when the worker list has shrunk: a
     # permanently-dead worker stays listed by the registry, so without
@@ -1640,11 +1784,30 @@ class FleetClient:
     _degraded_ttl_s = 5.0
     # floor between /healthz sweeps when route_around_degraded is on
     _health_poll_interval_s = 2.0
+    # rolling per-worker latency window feeding the adaptive hedge
+    # delay and the slow-outlier ejection
+    _latency_window = 128
+    # minimum samples before a worker's p95 participates in either
+    _min_latency_samples = 8
+    # a worker slower than this multiple of its peers' median p95 (and
+    # above the hedge-delay floor) is ejected from rotation
+    _slow_outlier_factor = 4.0
+    # hedge fires at this multiple of the typical worker p95: at 1x,
+    # ~5% of ORDINARY requests would hedge and drain the budget ahead
+    # of the genuine stragglers the hedge exists for
+    _hedge_delay_mult = 2.0
 
     def __init__(self, registry_url: str, timeout: float = 15.0,
                  retries_per_worker: int = 1,
                  refresh_interval_s: float = 30.0,
-                 route_around_degraded: bool = False):
+                 route_around_degraded: bool = False,
+                 hedging: bool = False,
+                 deadline_ms: Optional[float] = None,
+                 hedge_delay_ms: Optional[float] = None,
+                 hedge_budget_pct: Optional[float] = None,
+                 retry_budget_pct: Optional[float] = None,
+                 breaker_threshold: int = 3,
+                 breaker_open_s: float = 2.0):
         self.registry_url = registry_url
         self.timeout = timeout
         self.retries_per_worker = retries_per_worker
@@ -1653,6 +1816,36 @@ class FleetClient:
         # skip workers reporting status != ok (mid-swap, saturated
         # queue) while any healthy worker remains
         self.route_around_degraded = route_around_degraded
+        self.hedging = hedging
+        self.deadline_ms = (deadline_ms if deadline_ms is not None
+                            else env_float(REQUEST_DEADLINE_MS, 0.0,
+                                           minimum=0.0))
+        self.hedge_delay_ms = (hedge_delay_ms if hedge_delay_ms
+                               is not None
+                               else env_float(HEDGE_DELAY_MS, 30.0,
+                                              minimum=0.0))
+        # burst 8: hedging earns its keep in the first seconds after a
+        # worker goes gray (before the latency map has the samples to
+        # eject it) and at each degraded-TTL re-probe — windows where
+        # the pct-accrual alone would strangle it; steady-state load
+        # stays capped at pct% because the bucket stores at most burst
+        self._hedge_budget = FractionBudget(
+            hedge_budget_pct if hedge_budget_pct is not None
+            else env_float(HEDGE_BUDGET_PCT, 5.0, minimum=0.0),
+            burst=8.0)
+        self._retry_budget = FractionBudget(
+            retry_budget_pct if retry_budget_pct is not None
+            else env_float(RETRY_BUDGET_PCT, 10.0, minimum=0.0),
+            burst=8.0)
+        self._breaker_threshold = breaker_threshold
+        self._breaker_open_s = breaker_open_s
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._lat: Dict[str, deque] = {}  # url -> rolling latencies ms
+        self.stats = {"requests": 0, "hedges_fired": 0, "hedges_won": 0,
+                      "hedges_cancelled": 0, "hedge_denied": 0,
+                      "breaker_skips": 0, "retries": 0,
+                      "retries_shed": 0, "deadline_shed": 0,
+                      "slow_ejections": 0}
         self._workers: List[str] = []
         self._next = 0
         self._lock = sanitizer.san_lock("serving.fleet.client")
@@ -1715,30 +1908,120 @@ class FleetClient:
         if due:
             self.worker_health()
 
+    def _breaker(self, url: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(url)
+            if br is None:
+                br = self._breakers[url] = CircuitBreaker(
+                    failure_threshold=self._breaker_threshold,
+                    open_s=self._breaker_open_s)
+            return br
+
+    def _observe(self, url: str, lat_ms: float) -> None:
+        """Record one reply latency; with hedging on, eject a worker
+        that has gone clearly slower than its peers (gray: slow but
+        alive) from rotation via the degraded map — the TTL expiry
+        doubles as the re-probe that lets a recovered worker rejoin.
+        The victim needs only TWO consecutive over-threshold samples
+        (its peers' rolling p95s define the threshold, and THOSE need
+        ``_min_latency_samples`` each): a gray worker serves so slowly
+        that waiting for a full victim-side window would cost seconds
+        of tail latency per ejection."""
+        def p95(lat) -> float:
+            s = sorted(lat)
+            return s[min(len(s) - 1, int(0.95 * len(s)))]
+        with self._lock:
+            lat = self._lat.get(url)
+            if lat is None:
+                lat = self._lat[url] = deque(maxlen=self._latency_window)
+            lat.append(lat_ms)
+            if not self.hedging or len(lat) < 2:
+                return
+            others = [p95(l) for u, l in self._lat.items()
+                      if u != url and len(l) >= self._min_latency_samples]
+            if not others:
+                return
+            med = sorted(others)[len(others) // 2]
+            threshold = max(self._slow_outlier_factor * med,
+                            self.hedge_delay_ms)
+            recent = list(lat)[-2:]
+            if all(v > threshold for v in recent):
+                now = time.monotonic()
+                marked = self._degraded.get(url)
+                # (re-)eject when unmarked OR the mark has expired: a
+                # TTL re-probe that comes back still-slow must not slip
+                # past a stale entry back into full rotation
+                if (marked is None
+                        or now - marked > self._degraded_ttl_s):
+                    self._degraded[url] = now
+                    self.stats["slow_ejections"] += 1
+
+    def _hedge_delay_s(self) -> float:
+        """Adaptive hedge delay: ``_hedge_delay_mult`` times the median
+        of the per-worker rolling p95s (median is robust to the very
+        outlier being hedged around; the multiple keeps ordinary p95
+        stragglers from burning hedge budget), floored at
+        ``hedge_delay_ms``."""
+        with self._lock:
+            p95s = []
+            for lat in self._lat.values():
+                if len(lat) >= self._min_latency_samples:
+                    s = sorted(lat)
+                    p95s.append(s[min(len(s) - 1, int(0.95 * len(s)))])
+        delay_ms = self.hedge_delay_ms
+        if p95s:
+            delay_ms = max(delay_ms, self._hedge_delay_mult
+                           * sorted(p95s)[len(p95s) // 2])
+        return delay_ms / 1000.0
+
     def _pick(self, excluded: Optional[set] = None) -> Optional[str]:
         """Next worker in rotation, skipping ``excluded`` (workers that
         already dropped THIS request's connection — retrying them would
-        repeat the same failure) and, while alternatives remain,
-        degraded ones. All candidates degraded: degraded service beats
-        none. All candidates excluded: ``None`` — the caller
-        re-discovers."""
+        repeat the same failure), open-breaker workers (skipped with no
+        connect; a half-open probe re-admits) and, while alternatives
+        remain, degraded ones. All candidates degraded or blocked:
+        degraded service beats none. All candidates excluded: ``None``
+        — the caller re-discovers."""
         excluded = excluded or set()
         with self._lock:
             if not self._workers:
                 return None
             now = time.monotonic()
+            workers = list(self._workers)
+            # round-robin: each call starts one past the previous
+            # call's start, then walks the whole ring as fallbacks
+            start = self._next
+            self._next += 1
+            order = [workers[(start + k) % len(workers)]
+                     for k in range(len(workers))]
             degraded_fallback: Optional[str] = None
-            for _ in range(len(self._workers)):
-                url = self._workers[self._next % len(self._workers)]
-                self._next += 1
-                if url in excluded:
-                    continue
+            blocked_fallback: Optional[str] = None
+        for url in order:
+            if url in excluded:
+                continue
+            with self._lock:
                 marked = self._degraded.get(url)
-                if marked is None or now - marked > self._degraded_ttl_s:
-                    return url
+            if marked is not None and now - marked <= self._degraded_ttl_s:
                 if degraded_fallback is None:
                     degraded_fallback = url
-            return degraded_fallback
+                continue
+            br = self._breakers.get(url)
+            # allow() is consulted only on a candidate that is actually
+            # returned on True — a half-open probe slot must never be
+            # consumed by a worker this request then ignores
+            if br is None or br.allow():
+                return url
+            with self._lock:
+                self.stats["breaker_skips"] += 1
+            if blocked_fallback is None:
+                blocked_fallback = url
+        if degraded_fallback is not None:
+            br = self._breakers.get(degraded_fallback)
+            if br is None or br.allow():
+                return degraded_fallback
+        # total blackout: every candidate degraded or breaker-blocked —
+        # one bypassed attempt beats refusing service outright
+        return degraded_fallback or blocked_fallback
 
     def _maybe_refresh(self) -> None:
         """Re-discover workers when the local list has shrunk below the
@@ -1757,41 +2040,162 @@ class FleetClient:
             except Exception:
                 pass
 
-    def _post(self, url: str, data: bytes) -> Dict[str, Any]:
+    def _post(self, url: str, data: bytes,
+              abs_deadline: Optional[float] = None) -> Dict[str, Any]:
         import urllib.request
-        req = urllib.request.Request(
-            url, data=data,
-            headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+        # chaos boundary: the client socket layer — an armed delay is
+        # network RTT inflation, an armed raise a dropped connection
+        fault_point("net.latency")
+        headers = {"Content-Type": "application/json"}
+        timeout = self.timeout
+        if abs_deadline is not None:
+            # deadline propagation: the REMAINING budget rides as the
+            # X-Deadline-Ms header (never the original total — time
+            # already spent on refreshes/failovers is gone), and the
+            # socket timeout shrinks to it so a stalled worker cannot
+            # hold this attempt past the budget
+            remaining_ms = max(
+                (abs_deadline - time.monotonic()) * 1e3, 1.0)
+            headers["X-Deadline-Ms"] = f"{remaining_ms:.0f}"
+            timeout = min(timeout, remaining_ms / 1000.0 + 0.5)
+        req = urllib.request.Request(url, data=data, headers=headers)
+        with urllib.request.urlopen(req, timeout=timeout) as r:
             return json.loads(r.read())
+
+    def _call_worker(self, url: str, data: bytes,
+                     abs_deadline: Optional[float],
+                     failed: set, results: "queue_lib.Queue") -> None:
+        """One worker call with full accounting (latency observation,
+        breaker bookkeeping, dead-worker eviction); the outcome lands
+        on ``results`` so a hedge race takes the first reply."""
+        t0 = time.monotonic()
+        try:
+            reply = self._post(url, data, abs_deadline)
+        except Exception as e:
+            import urllib.error
+            if isinstance(e, urllib.error.HTTPError):
+                if e.code in (503, 504):  # alive-but-shedding
+                    with self._lock:
+                        self._degraded[url] = time.monotonic()
+            else:  # dead worker: breaker + evict + exclude
+                self._breaker(url).record_failure()
+                with self._lock:
+                    failed.add(url)
+                    if url in self._workers:
+                        self._workers.remove(url)
+            results.put((url, None, e))
+            return
+        self._observe(url, (time.monotonic() - t0) * 1e3)
+        self._breaker(url).record_success()
+        results.put((url, reply, None))
+
+    def _hedged_post(self, primary: str, data: bytes,
+                     abs_deadline: Optional[float],
+                     failed: set) -> Dict[str, Any]:
+        """One hedged attempt: the primary call runs on a worker
+        thread; if it has not resolved within the adaptive hedge delay,
+        the same request fires at a second worker (budget permitting)
+        and the FIRST reply wins — the loser is abandoned (counted
+        cancelled). Raises only when every in-flight leg failed."""
+        results: "queue_lib.Queue" = queue_lib.Queue()
+        threading.Thread(
+            target=self._call_worker,
+            args=(primary, data, abs_deadline, failed, results),
+            daemon=True, name="mmlspark-fleet-req").start()
+        outstanding = 1
+        try:
+            url, reply, err = results.get(timeout=self._hedge_delay_s())
+        except queue_lib.Empty:
+            hedge_url = self._pick(excluded=failed | {primary})
+            if hedge_url is not None and self._hedge_budget.take():
+                with self._lock:
+                    self.stats["hedges_fired"] += 1
+                threading.Thread(
+                    target=self._call_worker,
+                    args=(hedge_url, data, abs_deadline, failed,
+                          results),
+                    daemon=True, name="mmlspark-fleet-hedge").start()
+                outstanding += 1
+            elif hedge_url is not None:
+                with self._lock:
+                    self.stats["hedge_denied"] += 1
+            wait_s = self.timeout + 1.0
+            if abs_deadline is not None:
+                wait_s = min(wait_s, max(
+                    abs_deadline - time.monotonic(), 0.0) + 1.0)
+            try:
+                url, reply, err = results.get(timeout=wait_s)
+            except queue_lib.Empty:
+                raise TimeoutError(
+                    f"no reply from {primary} (or its hedge) within "
+                    f"{wait_s:.1f}s") from None
+        outstanding -= 1
+        while err is not None and outstanding > 0:
+            # the first leg lost; its sibling may still win
+            try:
+                url, reply, err = results.get(timeout=self.timeout + 1.0)
+                outstanding -= 1
+            except queue_lib.Empty:
+                break
+        if err is not None:
+            raise err
+        with self._lock:
+            if url != primary:
+                self.stats["hedges_won"] += 1
+            if outstanding > 0:
+                self.stats["hedges_cancelled"] += 1
+        return reply
 
     def score(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         """Score ``payload`` on some worker, failing over by error
         class: a connection-level failure (reset, refused, timeout)
-        means the worker is dead — evict it, exclude it from this
-        request's retries, and hedge on a DIFFERENT worker (scoring is
-        idempotent, so the retry is safe and the reply identical); a
-        503/504 means alive-but-shedding — mark degraded and rotate on
-        without evicting; any other HTTP status is a semantic error no
-        retry can fix and surfaces immediately."""
+        means the worker is dead — evict it, open its breaker a step,
+        exclude it from this request's retries, and fail over to a
+        DIFFERENT worker (scoring is idempotent, so the retry is safe
+        and the reply identical); a 503/504 means alive-but-shedding —
+        mark degraded and rotate on without evicting; any other HTTP
+        status is a semantic error no retry can fix and surfaces
+        immediately. Failover attempts draw from the global retry
+        budget; the request's remaining ``deadline_ms`` bounds every
+        leg (see the class docstring)."""
         import urllib.error
-        if not self._workers:
+        t_start = time.monotonic()
+        budget_ms = self.deadline_ms if self.deadline_ms > 0 else None
+        abs_deadline = (t_start + budget_ms / 1000.0
+                        if budget_ms is not None else None)
+        with self._lock:
+            have_workers = bool(self._workers)
+        if not have_workers:
             self.refresh()
         else:
             self._maybe_refresh()
         if self.route_around_degraded:
             self._maybe_poll_health()
         data = json.dumps(payload).encode()
-        n = max(len(self._workers), 1)
+        with self._lock:
+            self.stats["requests"] += 1
+            n = max(len(self._workers), 1)
+        self._retry_budget.note_request()
+        self._hedge_budget.note_request()
         attempts = max(n * self.retries_per_worker, 1)
         failed: set = set()  # connection-failed workers, this request
         last: Optional[Exception] = None
+        first = True
         for _ in range(attempts):
+            if not first:
+                self._spend_retry(last)  # raises once the budget drains
+                if abs_deadline is not None \
+                        and time.monotonic() >= abs_deadline:
+                    self._shed_deadline(budget_ms, last)
+            first = False
             url = self._pick(excluded=failed)
             if url is None:
                 break
             try:
-                return self._post(url, data)
+                if self.hedging:
+                    return self._hedged_post(url, data, abs_deadline,
+                                             failed)
+                return self._plain_post(url, data, abs_deadline)
             except urllib.error.HTTPError as e:
                 if e.code in (503, 504):
                     last = e
@@ -1799,7 +2203,7 @@ class FleetClient:
                         self._degraded[url] = time.monotonic()
                     continue
                 raise
-            except Exception as e:  # dead worker: evict + fail over
+            except Exception as e:  # dead worker(s): already evicted
                 last = e
                 failed.add(url)
                 with self._lock:
@@ -1807,11 +2211,18 @@ class FleetClient:
                         self._workers.remove(url)
         # last chance: addresses may be stale (fleet respawned workers
         # on fresh ports) — re-discover once and try a fresh worker
+        if last is not None:
+            self._spend_retry(last)  # raises once the budget drains
+        if abs_deadline is not None and time.monotonic() >= abs_deadline:
+            self._shed_deadline(budget_ms, last)
         try:
             self.refresh()
             url = self._pick(excluded=failed)
             if url is not None:
-                return self._post(url, data)
+                if self.hedging:
+                    return self._hedged_post(url, data, abs_deadline,
+                                             failed)
+                return self._plain_post(url, data, abs_deadline)
         except urllib.error.HTTPError:
             raise
         except Exception as e2:
@@ -1821,6 +2232,46 @@ class FleetClient:
                 f"registry {self.registry_url} lists no workers")
         raise RuntimeError(
             f"all workers failed after {attempts} attempts: {last}")
+
+    def _plain_post(self, url: str, data: bytes,
+                    abs_deadline: Optional[float]) -> Dict[str, Any]:
+        """Unhedged call with the same latency/breaker accounting."""
+        t0 = time.monotonic()
+        try:
+            reply = self._post(url, data, abs_deadline)
+        except Exception as e:
+            import urllib.error
+            if not isinstance(e, urllib.error.HTTPError):
+                self._breaker(url).record_failure()
+            raise
+        self._observe(url, (time.monotonic() - t0) * 1e3)
+        self._breaker(url).record_success()
+        return reply
+
+    def _spend_retry(self, last: Optional[Exception]) -> bool:
+        """Draw one token from the global retry budget before a
+        failover attempt; an empty bucket sheds the retry to the caller
+        with attribution (the brownout anti-amplification contract)."""
+        if self._retry_budget.take():
+            with self._lock:
+                self.stats["retries"] += 1
+            return True
+        with self._lock:
+            self.stats["retries_shed"] += 1
+        raise RuntimeError(
+            f"retry budget exhausted "
+            f"({self._retry_budget.pct:g}% of request volume): retry "
+            f"shed to caller instead of amplifying a fleet-wide "
+            f"brownout (last error: {last})")
+
+    def _shed_deadline(self, budget_ms: Optional[float],
+                       last: Optional[Exception]) -> None:
+        with self._lock:
+            self.stats["deadline_shed"] += 1
+        raise TimeoutError(
+            f"deadline exceeded: request budget "
+            f"{budget_ms:.0f} ms spent across failover attempts "
+            f"(last error: {last})")
 
 
 def serve_pipeline(model: Transformer, **kwargs) -> ServingServer:

@@ -24,10 +24,20 @@ Two methodologies, selected by flag:
   per-phase qps + p50/p99, shed counters, and the worker-count
   trajectory.
 
+- ``--hedging``: gray-failure bench — a 3-worker fleet with ONE seeded
+  slow worker (200 ms per batch, heartbeats fine) under closed-loop
+  FleetClient load, run twice: hedging+breakers OFF (the pre-change
+  client) and ON. Emits one ``serving_gray`` row per arm (p50/p99,
+  hedge/breaker/shed counters, measured extra backend load =
+  hedges_fired/requests, bitwise reply check against the model) plus a
+  p99-ratio summary row.
+
 Run: python tools/bench_serving.py [n_requests] [--cpu]
      python tools/bench_serving.py --sustained [--clients N]
                                    [--duration S] [--cpu]
      python tools/bench_serving.py --elastic [--clients N]
+                                   [--duration S] [--cpu]
+     python tools/bench_serving.py --hedging [--clients N]
                                    [--duration S] [--cpu]
 """
 
@@ -377,6 +387,148 @@ def emit_elastic(clients=16, duration_s=12.0, model_rows=None,
     return row
 
 
+def run_gray(model, rows, clients=8, duration_s=8.0, hedging=True,
+             gray_delay_ms=200.0, num_workers=3, deadline_ms=5000.0,
+             max_batch_size=16, max_latency_ms=2.0):
+    """One arm of the gray-failure bench: ``num_workers`` fleet with
+    ONE seeded slow worker (``gray_delay_ms`` added to every batch it
+    scores — slow, not dead: heartbeats keep passing), hammered by
+    ``clients`` closed-loop FleetClients with deadline propagation on
+    and hedging+breakers per ``hedging``. Every reply is checked
+    bitwise against the model's own transform. No supervisor runs: the
+    arm measures the CLIENT-side gray tolerance in isolation (the
+    supervisor-side recycle is chaosfuzz scenario 6's job)."""
+    import numpy as np
+
+    from mmlspark_tpu.core.dataframe import DataFrame
+    from mmlspark_tpu.io.serving import FleetClient, ServingFleet
+
+    fleet = ServingFleet(
+        model, num_servers=num_workers, max_batch_size=max_batch_size,
+        max_latency_ms=max_latency_ms, max_queue=8 * max_batch_size,
+        request_timeout_s=5.0, max_connections=2 * clients + 8,
+        reply_col="prediction").start()
+    payload_rows = rows[:64]
+    payloads = [{"features": row.tolist()} for row in payload_rows]
+    reference = [float(v) for v in model.transform(
+        DataFrame({"features": np.asarray(payload_rows)})).col(
+            "prediction")]
+    stop_at = [0.0]
+    barrier = threading.Barrier(clients + 1)
+    results = [None] * clients
+    # ONE client shared by every load thread (the deployment shape: a
+    # process-wide client), so the rolling latency map — and with it
+    # the slow-worker ejection — learns from the whole run's traffic
+    fc = FleetClient(fleet.registry_url, timeout=10.0,
+                     refresh_interval_s=1.0, hedging=hedging,
+                     deadline_ms=deadline_ms)
+
+    def client(idx):
+        lat, ok, shed, errs, mismatches = [], 0, 0, 0, 0
+        i = idx
+        barrier.wait()
+        while time.perf_counter() < stop_at[0]:
+            p = i % len(payloads)
+            t0 = time.perf_counter()
+            try:
+                reply = fc.score(dict(payloads[p]))
+            except (RuntimeError, TimeoutError):
+                # attributed shed (retry budget / deadline / rotation
+                # exhausted): honor the backpressure, then retry
+                shed += 1
+                time.sleep(0.002)
+                continue
+            except Exception:
+                errs += 1
+                continue
+            i += clients
+            ok += 1
+            lat.append((time.perf_counter() - t0) * 1e3)
+            if float(reply["prediction"]) != reference[p]:
+                mismatches += 1
+        results[idx] = (lat, ok, shed, errs, mismatches)
+
+    with fleet._servers_lock:
+        servers = list(fleet.servers)
+    servers[0].gray_delay_ms = gray_delay_ms  # the seeded gray worker
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t_start = time.perf_counter()
+    stop_at[0] = t_start + duration_s
+    for t in threads:
+        t.join(timeout=duration_s + 60)
+    wall = time.perf_counter() - t_start
+    served = shed_deadline = 0
+    for s in servers:
+        h = s._health()
+        served += h.get("served", 0)
+        shed_deadline += h.get("shed_deadline", 0)
+    fleet.stop()
+
+    client_stats = dict(fc.stats)
+    lat = [v for r in results if r for v in r[0]]
+    ok = sum(r[1] for r in results if r)
+    p50, p99 = _percentiles(lat)
+    return {
+        "metric": "serving_gray", "mode": "gray",
+        "arm": "hedged" if hedging else "plain",
+        "hedging": hedging, "clients": clients,
+        "duration_s": round(wall, 2),
+        "gray_delay_ms": gray_delay_ms, "workers": num_workers,
+        "deadline_ms": deadline_ms,
+        "qps": round(ok / wall, 1), "p50_ms": p50, "p99_ms": p99,
+        # measured extra backend load the hedges added (the <=5%
+        # budget contract), over the CLIENT's own request count
+        "extra_load_pct": (round(100.0 * client_stats["hedges_fired"]
+                                 / client_stats["requests"], 2)
+                           if client_stats["requests"] else 0.0),
+        **{k: v for k, v in client_stats.items() if k != "requests"},
+        "requests": client_stats["requests"],
+        "served": served, "shed_deadline_server": shed_deadline,
+        "client_shed": sum(r[2] for r in results if r),
+        "client_errors": sum(r[3] for r in results if r),
+        "reply_mismatches": sum(r[4] for r in results if r),
+        "replies_bitwise": sum(r[4] for r in results if r) == 0,
+        "san_lock_disabled_overhead_ns": _san_lock_disabled_overhead_ns(),
+        "model": MODEL_DESC,
+    }
+
+
+def emit_gray(clients=8, duration_s=8.0, model_rows=None, extra=None,
+              **kwargs):
+    """Run both gray-bench arms (hedging off first, then on), print one
+    JSON row per arm + a p99-ratio summary; returns the summary.
+    Shared by ``--hedging`` here and bench.py's ``--serving-gray``."""
+    import jax
+
+    model, rows = model_rows if model_rows is not None else build_model()
+    backend = jax.default_backend()
+    plain = run_gray(model, rows, clients=clients, duration_s=duration_s,
+                     hedging=False, **kwargs)
+    hedged = run_gray(model, rows, clients=clients,
+                      duration_s=duration_s, hedging=True, **kwargs)
+    for row in (plain, hedged):
+        row["backend"] = backend
+        print(json.dumps(row), flush=True)
+    summary = {
+        "metric": "serving_gray_p99_cut",
+        "value": (round(plain["p99_ms"] / hedged["p99_ms"], 2)
+                  if plain["p99_ms"] and hedged["p99_ms"] else None),
+        "unit": "x_vs_hedging_off",
+        "p99_ms_plain": plain["p99_ms"], "p99_ms_hedged": hedged["p99_ms"],
+        "extra_load_pct": hedged["extra_load_pct"],
+        "replies_bitwise": plain["replies_bitwise"]
+        and hedged["replies_bitwise"],
+        "clients": clients, "model": MODEL_DESC, "backend": backend,
+    }
+    summary.update(extra or {})
+    print(json.dumps(summary), flush=True)
+    return summary
+
+
 def _arg_value(flag, default):
     if flag in sys.argv:
         return type(default)(sys.argv[sys.argv.index(flag) + 1])
@@ -403,6 +555,11 @@ def main():
     if "--elastic" in sys.argv:
         emit_elastic(clients=_arg_value("--clients", 16),
                      duration_s=_arg_value("--duration", 12.0))
+        return
+
+    if "--hedging" in sys.argv:
+        emit_gray(clients=_arg_value("--clients", 8),
+                  duration_s=_arg_value("--duration", 8.0))
         return
 
     import urllib.request

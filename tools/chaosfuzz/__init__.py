@@ -4,8 +4,10 @@
 fault *schedules* — (point, action, nth-hit) tuples drawn from the
 canonical ``mmlspark_tpu.core.faults.KNOWN_POINTS`` registry — and runs
 each against a small end-to-end scenario (in-core fit, out-of-core fit,
-streaming refresh, serving swap, and the composed train-while-serve
-platform loop), asserting the framework's resilience invariants:
+streaming refresh, serving swap, the composed train-while-serve
+platform loop, and a gray-degraded fleet behind a hedging
+deadline-propagating client), asserting the framework's resilience
+invariants:
 
   1. **no hang** — every schedule completes (or is aborted and counted
      as a violation) within the watchdog budget, enforced with
@@ -21,7 +23,11 @@ platform loop), asserting the framework's resilience invariants:
   4. **zero dropped requests** (train-while-serve only) — no in-flight
      request may drop across a fleet-wide swap window unless a
      serving-plane fault is armed, and a fan-out rollback leaves every
-     worker serving the old model bitwise-unchanged.
+     worker serving the old model bitwise-unchanged;
+  5. **bounded tails** (gray-fleet only) — no request exceeds its
+     propagated deadline unattributed, hedged load stays inside the
+     client's hedge-budget contract, and the supervisor recycles the
+     gray (slow-not-dead) worker.
 
 Action profiles are derived from ``KNOWN_POINTS`` *at runtime*, so a
 fault point added in a future PR is fuzzed automatically with the

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 import urllib.error
 import urllib.request as urllib_request
 from dataclasses import dataclass, field
@@ -190,6 +191,120 @@ def _serving_attributed(e: BaseException, armed: FrozenSet[str],
     if any(p in text for p in armed):
         return True
     return any(p in armed for p in points)
+
+
+# the gray-fleet scenario's accept-set: its requests cross the client
+# socket layer (net.latency), the server handler (net.half_open), the
+# reply write path (net.slow_reply) and the scoring plane; heartbeat
+# faults can delay the recycle but never drop a request
+_GRAY_POINTS = ("net.latency", "net.half_open", "net.slow_reply",
+                "serving.score", "serving.worker_kill")
+
+
+def gray_fleet(work_dir: str, armed: FrozenSet[str]) -> dict:
+    """Scenario 6: a fleet with one GRAY worker — alive, heartbeats
+    passing, but serving at ~40x latency — behind a hedging
+    deadline-propagating :class:`FleetClient`, with net.* chaos fuzzed
+    on top.  Invariants beyond the campaign's standing three:
+
+      - no request exceeds its deadline unattributed (a failure must be
+        an attributed deadline/retry-budget shed or an armed fault);
+      - hedge load stays within the client's ``hedge_budget_pct``
+        contract (burst + pct% of request volume);
+      - every reply that does come back is bitwise-identical to the
+        healthy-fleet baseline (hedged duplicates included);
+      - the supervisor classifies the gray worker as degraded and
+        recycles it — required only while the p99 signal is INTACT: an
+        armed fault in the serving path can inflate a healthy peer's
+        p99 past the seeded outlier (``serving.score`` delay), starve
+        the gray worker of the traffic its rolling window needs
+        (``net.*`` raises shift the client's routing), blind a
+        detection sweep (``fleet.heartbeat``), or kill a worker
+        outright (then death eviction is the accepted outcome); under
+        any of those the recycle is best-effort, and the detection
+        contract is pinned instead by the unfaulted baseline run the
+        campaign executes for every schedule."""
+    from mmlspark_tpu.io.fleet import FleetSupervisor
+    from mmlspark_tpu.io.serving import FleetClient, ServingFleet
+
+    model = _base_model()
+    xs, _ = _data(7, 8)
+    deadline_ms = 8000.0
+    replies: Dict[str, float] = {}
+
+    def attributed(e: BaseException) -> bool:
+        return _serving_attributed(e, armed, points=_GRAY_POINTS)
+
+    fleet = ServingFleet(model, num_servers=3, max_batch_size=4,
+                         max_latency_ms=2.0)
+    sup = FleetSupervisor(fleet, min_workers=3, max_workers=3,
+                          gray_factor=3.0, gray_min_p99_ms=30.0,
+                          gray_streak=2, drain_timeout_s=5.0)
+    with fleet:
+        # one sustained gray worker: replies crawl out at ~120ms while
+        # /healthz keeps answering instantly
+        fleet.servers[-1].gray_delay_ms = 120.0
+        client = FleetClient(fleet.registry_url, timeout=5.0,
+                             refresh_interval_s=0.1, hedging=True,
+                             deadline_ms=deadline_ms,
+                             hedge_delay_ms=20.0)
+
+        def req(i: int) -> None:
+            t0 = time.monotonic()
+            try:
+                r = client.score({"features": xs[i % len(xs)].tolist()})
+                replies[str(i)] = float(r["prediction"])
+            except Exception as e:
+                if not attributed(e):
+                    raise Unattributed(
+                        f"request {i} failed outside any armed fault: "
+                        f"{type(e).__name__}: {e}") from e
+            elapsed_ms = (time.monotonic() - t0) * 1e3
+            if elapsed_ms > deadline_ms + 1000.0:
+                raise Unattributed(
+                    f"request {i} took {elapsed_ms:.0f} ms against an "
+                    f"{deadline_ms:.0f} ms propagated deadline without "
+                    f"an attributed shed")
+
+        # phase 1: load through the gray fleet — enough traffic for the
+        # gray worker's /healthz p99 to carry the outlier signal
+        for i in range(12):
+            req(i)
+        # supervision passes: the p99-outlier sweep must classify the
+        # gray worker and recycle it (streak=2, so >=3 ticks even with
+        # one heartbeat fault burned)
+        for _ in range(8):
+            sup.tick()
+            stats = sup.stats()
+            if stats["gray_recycles"] or stats["deaths"]:
+                break
+        stats = sup.stats()
+        # armed faults in the serving path distort the very signal the
+        # sweep classifies on (see the docstring) — the recycle is
+        # guaranteed only when none of them fired this run
+        signal_intact = not (armed & {
+            "serving.score", "serving.worker_kill", "fleet.heartbeat",
+            "net.latency", "net.half_open", "net.slow_reply"})
+        if (stats["gray_recycles"] == 0 and stats["deaths"] == 0
+                and signal_intact):
+            raise Unattributed(
+                "gray worker (p99 ~40x its peers, heartbeats passing) "
+                f"was never recycled across 8 supervision passes: "
+                f"{stats}")
+        # phase 2: load through the recycled (healthy) fleet
+        for i in range(12, 24):
+            req(i)
+        # hedge load must stay within the advertised budget: burst
+        # tokens + pct% of request volume, measured over the whole run
+        hedge = client._hedge_budget
+        allowed = hedge.burst + hedge.pct / 100.0 * client.stats["requests"]
+        if client.stats["hedges_fired"] > allowed + 1e-9:
+            raise Unattributed(
+                f"hedge load {client.stats['hedges_fired']} exceeds "
+                f"the {hedge.pct:g}% budget "
+                f"(allowed {allowed:.2f} over "
+                f"{client.stats['requests']} requests)")
+    return {"replies": replies}
 
 
 def train_while_serve(work_dir: str, armed: FrozenSet[str]) -> dict:
@@ -437,4 +552,9 @@ def all_scenarios() -> Tuple[Scenario, ...]:
                   "refresh.fit", "checkpoint.write", "io.disk_full",
                   "spill.read", "gbdt.train_step", "fleet.spawn"),
                  compare=_compare_platform),
+        Scenario("gray_fleet", gray_fleet,
+                 ("net.latency", "net.half_open", "net.slow_reply",
+                  "serving.score", "fleet.heartbeat",
+                  "serving.worker_kill", "fleet.spawn"),
+                 resumable=False, compare=_compare_replies),
     )
