@@ -788,10 +788,12 @@ def serving_gray_main():
 def serving_sustained_main():
     """``python bench.py --serving-sustained``: the serving-path row —
     64 keep-alive clients for a fixed duration against the generic
-    transform arm and the binned bucket-padded data plane, one JSON
-    row per arm plus the QPS-ratio summary (tools/bench_serving.py
-    emit_sustained). BENCH_SERVING_CLIENTS / BENCH_SERVING_DURATION_S
-    override the load shape for rehearsals."""
+    transform arm, the binned bucket-padded data plane, and the binned
+    plane under MMLSPARK_TPU_INFER_AUTOCAST=bf16; one JSON row per arm
+    plus the QPS-ratio summaries (serving_sustained_speedup and
+    serving_bf16_speedup with score_max_abs_delta_vs_f32,
+    tools/bench_serving.py emit_sustained). BENCH_SERVING_CLIENTS /
+    BENCH_SERVING_DURATION_S override the load shape for rehearsals."""
     platform = wait_for_backend(metric="serving_sustained", unit="qps",
                                 allow_cpu_fallback=True)
     print(f"# backend up: {platform}", file=sys.stderr, flush=True)

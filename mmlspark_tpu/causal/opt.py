@@ -60,7 +60,7 @@ def mirror_descent(a, b, l2: float = 0.0, max_iter: int = 500,
             return (w, jnp.minimum(new_loss, best_loss), delta, it + 1,
                     cur_step)
 
-        w0 = jnp.full(n, 1.0 / n)
+        w0 = jnp.full(n, 1.0 / n, dtype=jnp.float32)
         w, _, _, _, _ = jax.lax.while_loop(
             cond, body, (w0, loss(w0), jnp.inf, 0, jnp.asarray(step)))
         return w

@@ -121,6 +121,11 @@ SAN_LOCK_HOLD_MS = register(
     "is held longer than this many milliseconds, naming the acquire "
     "site (0 = hold-time check off; order-inversion detection is "
     "always on under MMLSPARK_TPU_SAN=1)")
+SAN_DTYPE = register(
+    "MMLSPARK_TPU_SAN_DTYPE", "flag", True,
+    "with graftsan enabled: record dtype-signature contracts at parity "
+    "boundaries and raise DtypeDrift on signature change (=0 keeps "
+    "MMLSPARK_TPU_SAN=1 but turns only the dtype-contract check off)")
 HIST_QUANT = register(
     "MMLSPARK_TPU_HIST_QUANT", "str", "off",
     "gradient/hessian quantization for histogram construction: "

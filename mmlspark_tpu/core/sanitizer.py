@@ -21,6 +21,16 @@ manifest with real data on real meshes:
   misses through :func:`count_recompile`; a per-process budget
   (``MMLSPARK_TPU_SAN_RECOMPILE_BUDGET``) turns GL003's static
   recompilation hazards into a hard runtime signal.
+* **dtype contracts (graftdtype)** — :func:`check_dtype_contract`
+  records the dtype-signature pytree of every value crossing a parity
+  boundary (trainer scan entry/exit, native-callback returns, the
+  serving score path) the first time it crosses, and raises
+  :class:`DtypeDrift` naming the boundary and the leaf path the moment
+  a later crossing disagrees — the runtime counterpart of graftlint
+  GL013–GL016, catching the width drift those rules cannot prove from
+  source (data-dependent promotion, config-flipped defaults). The
+  check itself is gated by ``MMLSPARK_TPU_SAN_DTYPE`` (default on) so
+  the rest of the sanitizer can run with contracts off.
 * **lock-order recorder (graftlock)** — :func:`san_lock` wraps the
   serving plane's locks/conditions; enabled, every acquire records the
   per-thread held-set and checks the acquisition against a global
@@ -58,8 +68,9 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 __all__ = [
     "SanitizerError", "NonFiniteError", "CollectiveDivergence",
     "RecompileBudgetExceeded", "LockOrderViolation",
-    "SanLockHoldWarning", "enabled", "enable", "disable",
-    "refresh_from_env", "reset", "check_finite", "record_collective",
+    "SanLockHoldWarning", "DtypeDrift", "enabled", "enable", "disable",
+    "refresh_from_env", "reset", "check_finite",
+    "check_dtype_contract", "dtype_contracts", "record_collective",
     "CollectiveRecorder", "recorder", "use_recorder", "last_collective",
     "step_boundary",
     "crosscheck_hashes", "count_recompile", "recompile_count",
@@ -98,6 +109,22 @@ class LockOrderViolation(SanitizerError):
         self.acquiring = acquiring
 
 
+class DtypeDrift(SanitizerError):
+    """A value crossed a parity boundary with a dtype signature that
+    disagrees with the one recorded at the boundary's first crossing.
+    Carries the boundary name, the drifting leaf's pytree path and the
+    before/after dtype names."""
+
+    def __init__(self, message: str, boundary: str = "",
+                 leaf: str = "", before: str = "",
+                 after: str = "") -> None:
+        super().__init__(message)
+        self.boundary = boundary
+        self.leaf = leaf
+        self.before = before
+        self.after = after
+
+
 class SanLockHoldWarning(RuntimeWarning):
     """A san_lock was held past MMLSPARK_TPU_SAN_LOCK_HOLD_MS."""
 
@@ -129,25 +156,30 @@ def disable() -> None:
 
 def refresh_from_env() -> None:
     """Re-read ``MMLSPARK_TPU_SAN`` / ``MMLSPARK_TPU_SAN_RECOMPILE_BUDGET``
-    / ``MMLSPARK_TPU_SAN_LOCK_HOLD_MS`` (call after changing them
-    in-process, e.g. under ``env_override``)."""
+    / ``MMLSPARK_TPU_SAN_LOCK_HOLD_MS`` / ``MMLSPARK_TPU_SAN_DTYPE``
+    (call after changing them in-process, e.g. under
+    ``env_override``)."""
     global _enabled, _recompile_budget, _lock_hold_budget_ms
-    from mmlspark_tpu.core.env import (SAN, SAN_LOCK_HOLD_MS,
+    global _dtype_enabled
+    from mmlspark_tpu.core.env import (SAN, SAN_DTYPE, SAN_LOCK_HOLD_MS,
                                        SAN_RECOMPILE_BUDGET, env_flag,
                                        env_float, env_int)
     _enabled = env_flag(SAN, False)
     _recompile_budget = env_int(SAN_RECOMPILE_BUDGET, 0, minimum=0)
     _lock_hold_budget_ms = env_float(SAN_LOCK_HOLD_MS, 0.0, minimum=0.0)
+    _dtype_enabled = env_flag(SAN_DTYPE, True)
 
 
 def reset() -> None:
     """Clear recorded state (collective events, recompile counter,
-    lock-order graph) without touching the enabled flag. Run-start and
-    test hook."""
+    lock-order graph, dtype contracts) without touching the enabled
+    flag. Run-start and test hook."""
     global _recompiles
     with _lock:
         _recompiles = 0
         _recent_recompiles.clear()
+    with _dtype_lock:
+        _dtype_contracts.clear()
     _recorder.clear()
     with _order_lock:
         _order_edges.clear()
@@ -212,6 +244,87 @@ def _find_non_finite(value: Any, path: str
     nan_count = int(np.isnan(arr).sum())
     inf_count = int(np.isinf(arr).sum())
     return (path, nan_count, inf_count, tuple(arr.shape))
+
+
+# --- dtype contracts (graftdtype runtime twin) ------------------------------
+
+_dtype_enabled = True          # secondary gate under _enabled
+_dtype_lock = threading.Lock()
+# boundary name -> {leaf path: dtype name} recorded at first crossing
+_dtype_contracts: Dict[str, Dict[str, str]] = {}
+
+
+def check_dtype_contract(boundary: str, value: Any) -> Any:
+    """Return ``value`` unchanged; when the sanitizer is enabled (and
+    ``MMLSPARK_TPU_SAN_DTYPE`` is not 0), record the dtype signature of
+    every array leaf in ``value`` the first time ``boundary`` is
+    crossed, and raise :class:`DtypeDrift` naming the boundary and the
+    drifting leaf when a later crossing disagrees.
+
+    Only leaves present in *both* signatures are compared: boundaries
+    with optional payloads (a probe batch without labels, a scan carry
+    that grows a slot) don't false-positive on arity. Disabled cost:
+    one boolean check."""
+    if not _enabled:
+        return value
+    if not _dtype_enabled:
+        return value
+    sig: Dict[str, str] = {}
+    _dtype_signature(value, "value", sig)
+    with _dtype_lock:
+        recorded = _dtype_contracts.get(boundary)
+        if recorded is None:
+            _dtype_contracts[boundary] = sig
+            return value
+        for leaf, dt in sig.items():
+            before = recorded.get(leaf)
+            if before is not None and before != dt:
+                raise DtypeDrift(
+                    f"graftsan: dtype drift at parity boundary "
+                    f"{boundary!r}: leaf {leaf} was {before} at the "
+                    f"first crossing, now {dt} — a width change on a "
+                    f"parity path silently breaks resume/failover "
+                    f"bitwise parity (graftlint GL013-GL016's runtime "
+                    f"counterpart); pin the dtype at the producer or "
+                    f"reset() if the contract legitimately changed",
+                    boundary=boundary, leaf=leaf, before=before,
+                    after=dt)
+        recorded.update(
+            (k, v) for k, v in sig.items() if k not in recorded)
+    return value
+
+
+def _dtype_signature(value: Any, path: str, out: Dict[str, str]) -> None:
+    """Walk ``value`` like :func:`_find_non_finite`, collecting
+    ``{leaf path: dtype name}`` for every array leaf (anything with a
+    numpy-coercible ``dtype``); host scalars and strings carry no width
+    contract and are skipped."""
+    import numpy as np
+    if value is None or isinstance(value, (bool, int, float, str,
+                                           bytes)):
+        return
+    if isinstance(value, dict):
+        for k, v in value.items():
+            _dtype_signature(v, f"{path}[{k!r}]", out)
+        return
+    if isinstance(value, (list, tuple)):
+        for i, v in enumerate(value):
+            _dtype_signature(v, f"{path}[{i}]", out)
+        return
+    dtype = getattr(value, "dtype", None)
+    if dtype is None:
+        return
+    try:
+        out[path] = np.dtype(dtype).name
+    except TypeError:
+        return    # extension dtypes (e.g. jax PRNG keys): no contract
+
+
+def dtype_contracts() -> Dict[str, Dict[str, str]]:
+    """Snapshot of the recorded per-boundary dtype signatures
+    (test/debug hook)."""
+    with _dtype_lock:
+        return {b: dict(sig) for b, sig in _dtype_contracts.items()}
 
 
 # --- collective-sequence recorder ------------------------------------------

@@ -1005,6 +1005,8 @@ class ServingServer:
                 "verification batch", served.name)
             return
         sanitizer.check_finite("serving.score", cols)
+        sanitizer.check_dtype_contract(
+            f"serving.score.{served.name}", cols)
 
     def swap_model(self, name: str, model: Transformer,
                    probe_payload: Optional[Dict[str, Any]] = None
@@ -1518,8 +1520,12 @@ FleetSupervisor` notices via missed heartbeats and respawns."""
             cols = {c: out.col(c) for c in reply_cols}
             served.stats["generic_batches"] += 1
         # score-path jit-boundary guard: a NaN prediction here would
-        # otherwise serialize into a client-visible JSON "NaN"
+        # otherwise serialize into a client-visible JSON "NaN"; the
+        # dtype contract pins the reply width per served model so an
+        # autocast flip cannot silently change the wire precision
         sanitizer.check_finite("serving.score", cols)
+        sanitizer.check_dtype_contract(
+            f"serving.score.{served.name}", cols)
         t_done = time.monotonic()
         for i, p in enumerate(batch):
             reply = {}

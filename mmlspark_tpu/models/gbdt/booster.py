@@ -255,15 +255,18 @@ class BoosterArrays:
             x = jnp.asarray(x)
             acc = jnp.full((x.shape[0], k), self.init_score, dtype=jnp.float32)
             (acc, _), _ = jax.lax.scan(
-                one_tree, (acc, x), jnp.arange(self.num_trees))
+                one_tree, (acc, x), jnp.arange(self.num_trees, dtype=jnp.int32))
             return acc[:, 0] if k == 1 else acc
 
         return predict
 
-    def predict_binned_jit(self):
-        return self._jitted("predict_binned", self.predict_binned_fn)
+    def predict_binned_jit(self, autocast: str = "off"):
+        if autocast == "off":
+            return self._jitted("predict_binned", self.predict_binned_fn)
+        return self._jitted(f"predict_binned.{autocast}",
+                            lambda: self.predict_binned_fn(autocast))
 
-    def predict_binned_fn(self):
+    def predict_binned_fn(self, autocast: str = "off"):
         """Returns jittable fn: BINNED features (N, F) small-int bin ids
         (the ``BinMapper.transform`` output the model was trained on) ->
         raw scores, identical to ``predict_fn`` on the raw features.
@@ -283,10 +286,24 @@ class BoosterArrays:
         end-to-end on CPU, tools/bench_scoring.py). Numerical splits
         only: categorical models route by raw-value bitsets, so they
         take ``predict_fn``.
+
+        ``autocast="bf16"`` places the leaf-value table at bfloat16
+        through the ``shard_rules.placement_cast`` seam (halving the
+        hot gather's bytes); the per-tree contribution promotes back to
+        float32 against the f32 tree weights, so accumulation stays at
+        full width (GL015's contract) and only the stored leaf values
+        are rounded — error is bounded by bf16's 2^-8 relative step per
+        leaf, summed over the trees. ``"off"`` (the default) is
+        bitwise-identical to the pre-autocast path: same closure, no
+        cast, same jit cache key.
         """
         import jax
         import jax.numpy as jnp
 
+        if autocast not in ("off", "bf16"):
+            raise ValueError(
+                f"predict_binned_fn: autocast={autocast!r} not in "
+                f"('off', 'bf16')")
         if not self.supports_binned:
             if self.has_categorical:
                 raise NotImplementedError(
@@ -302,6 +319,9 @@ class BoosterArrays:
         tb = jnp.asarray(self.threshold_bin)
         nv = jnp.asarray(self.node_value)
         tw = jnp.asarray(self.tree_weights)
+        if autocast == "bf16":
+            from mmlspark_tpu.parallel.shard_rules import placement_cast
+            nv = placement_cast(nv, jnp.bfloat16)
         depth, k = self.max_depth, self.num_class
 
         def one_tree(carry, tree_idx):
@@ -329,7 +349,7 @@ class BoosterArrays:
             acc = jnp.full((bd.shape[0], k), self.init_score,
                            dtype=jnp.float32)
             (acc, _), _ = jax.lax.scan(
-                one_tree, (acc, bd), jnp.arange(self.num_trees))
+                one_tree, (acc, bd), jnp.arange(self.num_trees, dtype=jnp.int32))
             return acc[:, 0] if k == 1 else acc
 
         return predict_binned
@@ -454,7 +474,7 @@ class BoosterArrays:
                     node = jnp.where(is_leaf, node, child)
                 return x_c, node
 
-            _, out = jax.lax.scan(one_tree, x, jnp.arange(self.num_trees))
+            _, out = jax.lax.scan(one_tree, x, jnp.arange(self.num_trees, dtype=jnp.int32))
             return out.T  # (N, T)
 
         return leaves
@@ -617,7 +637,7 @@ class BoosterArrays:
 
             acc = jnp.zeros((n, n_cls, num_f + 1), dtype=jnp.float32)
             acc = acc.at[:, :, num_f].add(self.init_score)
-            acc, _ = jax.lax.scan(one_tree, acc, jnp.arange(self.num_trees))
+            acc, _ = jax.lax.scan(one_tree, acc, jnp.arange(self.num_trees, dtype=jnp.int32))
             return (acc[:, 0] if n_cls == 1
                     else acc.reshape(n, n_cls * (num_f + 1)))
 
@@ -661,7 +681,7 @@ class BoosterArrays:
                     child = jnp.where(is_leaf, node, child)
                     delta = (nv[tree_idx][child] - nv[tree_idx][node]) * tw[tree_idx]
                     upd = jnp.where(is_leaf, 0.0, delta)
-                    c = c.at[jnp.arange(n), jnp.maximum(feat, 0)].add(upd)
+                    c = c.at[jnp.arange(n, dtype=jnp.int32), jnp.maximum(feat, 0)].add(upd)
                     node = child
                 cls = tree_idx % k
                 acc = acc.at[:, cls, :num_f].add(c)
@@ -670,7 +690,7 @@ class BoosterArrays:
 
             acc = jnp.zeros((n, k, num_f + 1), dtype=jnp.float32)
             acc = acc.at[:, :, num_f].add(self.init_score)
-            acc, _ = jax.lax.scan(one_tree, acc, jnp.arange(self.num_trees))
+            acc, _ = jax.lax.scan(one_tree, acc, jnp.arange(self.num_trees, dtype=jnp.int32))
             return (acc[:, 0] if k == 1
                     else acc.reshape(n, k * (num_f + 1)))
 

@@ -763,6 +763,9 @@ class ServingBinnedPlan:
     ingest_dtype: Any
     num_features: int
     features_col: str
+    # resolved MMLSPARK_TPU_INFER_AUTOCAST policy the scorer was built
+    # under ("off" | "bf16") — surfaced so bench/serving rows name it
+    autocast: str = "off"
 
 
 class _LightGBMModelBase(Model, _LightGBMParams):
@@ -968,8 +971,16 @@ class _LightGBMModelBase(Model, _LightGBMParams):
         applied; imported model strings (raw thresholds only) recover a
         binning from their own splits via ``derive_binning``. Either
         way rows move at the narrowest ingest dtype (uint8 for <=256
-        bins) and route bitwise-identically to ``transform``."""
+        bins) and route bitwise-identically to ``transform``.
+
+        ``MMLSPARK_TPU_INFER_AUTOCAST=bf16`` (resolved through
+        ``shard_rules.resolve_infer_autocast``'s warn-once policy)
+        builds the scorer with the leaf-value table placed at bf16;
+        routing and accumulation are unchanged, so only the final
+        margins carry the rounding (see ``predict_binned_fn``)."""
         from mmlspark_tpu.ops.ingest import binned_ingest_dtype
+        from mmlspark_tpu.parallel.shard_rules import \
+            resolve_infer_autocast
         if self.booster is None:
             raise BinnedServingUnsupported("model has no fitted booster")
         if self._mesh is not None:
@@ -980,6 +991,7 @@ class _LightGBMModelBase(Model, _LightGBMParams):
             raise BinnedServingUnsupported(
                 "leafPredictionCol/featuresShapCol require raw features")
         b = self.scoring_booster
+        autocast = resolve_infer_autocast()
         features_col = self.get("featuresCol")
         expected_f = self.booster.num_features
         check_shape = not self.get("predictDisableShapeCheck")
@@ -1013,7 +1025,7 @@ class _LightGBMModelBase(Model, _LightGBMParams):
                     x = np.where(x == 0.0, np.nan, x)
                 return mapper.transform(x).astype(dtype)
 
-            score = b.predict_binned_jit()
+            score = b.predict_binned_jit(autocast)
         else:
             try:
                 binning, derived = b.derive_binning()
@@ -1025,13 +1037,13 @@ class _LightGBMModelBase(Model, _LightGBMParams):
             def bin_rows(x: np.ndarray) -> np.ndarray:
                 return binning.transform(_check(x))
 
-            score = derived.predict_binned_jit()
+            score = derived.predict_binned_jit(autocast)
 
         return ServingBinnedPlan(
             bin_rows=bin_rows, score=score,
             finish=self._reply_columns_from_raw,
             ingest_dtype=dtype, num_features=expected_f,
-            features_col=features_col)
+            features_col=features_col, autocast=autocast)
 
 
 # ---------------------------------------------------------------------------

@@ -121,7 +121,7 @@ def group_ranks(scores: Array, group_ids: Array) -> Array:
     order1 = jnp.argsort(-scores, stable=True)
     order2 = jnp.argsort(group_ids[order1], stable=True)
     perm = order1[order2]  # lexicographic (group, -score)
-    pos = jnp.arange(n)
+    pos = jnp.arange(n, dtype=jnp.int32)
     pg = group_ids[perm]
     is_start = jnp.concatenate([jnp.ones(1, dtype=bool), pg[1:] != pg[:-1]])
     start_pos = jax.lax.associative_scan(

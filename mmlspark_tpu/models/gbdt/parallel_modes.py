@@ -67,7 +67,7 @@ def _split_gains(hist, leaf_objective, cfg, b):
     ok = ((cl >= min_child) & (cr >= min_child)
           & (hl >= min_hess) & (hr >= min_hess)
           & (gain > min_gain))
-    ok &= jnp.arange(b)[None, None, :] < b - 1
+    ok &= jnp.arange(b, dtype=jnp.int32)[None, None, :] < b - 1
     return jnp.where(ok, gain, -jnp.inf), cum
 
 
@@ -182,7 +182,7 @@ def make_build_tree_voting(num_features: int, total_bins: int, cfg,
                               votes.dtype)
             votes = jax.lax.psum(votes, DATA_AXIS)          # (width, f)
             # deterministic tie-break toward lower feature ids
-            votes = votes - jnp.arange(f)[None, :] * 1e-6
+            votes = votes - jnp.arange(f, dtype=jnp.int32)[None, :] * 1e-6
             _, cand_feats = jax.lax.top_k(votes, cand)      # (width, cand)
 
             # ---- reduce ONLY candidate histograms ----------------------
@@ -214,13 +214,13 @@ def make_build_tree_voting(num_features: int, total_bins: int, cfg,
             do_split = can_split & (rank < remaining)
             remaining = remaining - jnp.sum(do_split.astype(jnp.int32))
 
-            slots = level_start + jnp.arange(width)
+            slots = level_start + jnp.arange(width, dtype=jnp.int32)
             split_feature = split_feature.at[slots].set(
                 jnp.where(do_split, best_feat, -1))
             threshold_bin = threshold_bin.at[slots].set(
                 jnp.where(do_split, best_bin, 0))
 
-            sel = jnp.arange(width)
+            sel = jnp.arange(width, dtype=jnp.int32)
             cum_best = cum_cand[sel, best_cand]          # (width, B, 3)
             left_stats = jnp.take_along_axis(
                 cum_best, best_bin[:, None, None], axis=1)[:, 0, :]
@@ -347,7 +347,7 @@ def make_build_tree_data_parallel(num_features: int, total_bins: int,
         # ---- reduce-scatter: each replica receives ONLY its feature
         # slice of the summed histogram -------------------------------
         feat_off = shard * f_loc
-        own_ids = feat_off + jnp.arange(f_loc)
+        own_ids = feat_off + jnp.arange(f_loc, dtype=jnp.int32)
         # owned-slice feat mask: zero past F, so padded columns (and
         # per-tree-masked features) never win
         own_mask = jnp.where(own_ids < f,
@@ -388,10 +388,11 @@ def make_build_tree_data_parallel(num_features: int, total_bins: int,
 
         # ---- child stats: winner supplies (serial masked-sum
         # formulation), masked psums broadcast ------------------------
-        sel = jnp.arange(width)
+        sel = jnp.arange(width, dtype=jnp.int32)
         loc_best_idx = (loc_fb // b).astype(jnp.int32)
         hist_best = hist_loc[sel, loc_best_idx]      # (width, B, 3)
-        left_mask = jnp.arange(b)[None, :] <= loc_bin[:, None]
+        bin_ids = jnp.arange(b, dtype=jnp.int32)
+        left_mask = bin_ids[None, :] <= loc_bin[:, None]
         left_loc = jnp.sum(hist_best * left_mask[..., None], axis=1)
         tot_loc = jnp.sum(hist_best, axis=1)
         record_collective("psum", DATA_AXIS, left_loc.shape,
@@ -417,9 +418,10 @@ def make_build_tree_data_parallel(num_features: int, total_bins: int,
         best_gain = jnp.take_along_axis(flat, best_fb[:, None], 1)[:, 0]
         best_feat = (best_fb // b).astype(jnp.int32)
         best_bin = (best_fb % b).astype(jnp.int32)
-        sel = jnp.arange(width)
+        sel = jnp.arange(width, dtype=jnp.int32)
         hist_best = hist_full[sel, best_feat]        # (width, B, 3)
-        left_mask = jnp.arange(b)[None, :] <= best_bin[:, None]
+        bin_ids = jnp.arange(b, dtype=jnp.int32)
+        left_mask = bin_ids[None, :] <= best_bin[:, None]
         left_stats = jnp.sum(hist_best * left_mask[..., None], axis=1)
         tot_stats = jnp.sum(hist_best, axis=1)
         return best_feat, best_bin, best_gain, left_stats, tot_stats
@@ -466,7 +468,7 @@ def make_build_tree_data_parallel(num_features: int, total_bins: int,
             do_split = can_split & (rank < remaining)
             remaining = remaining - jnp.sum(do_split.astype(jnp.int32))
 
-            slots = level_start + jnp.arange(width)
+            slots = level_start + jnp.arange(width, dtype=jnp.int32)
             split_feature = split_feature.at[slots].set(
                 jnp.where(do_split, best_feat, -1))
             threshold_bin = threshold_bin.at[slots].set(
@@ -610,14 +612,14 @@ def make_build_tree_feature_parallel(num_features: int, total_bins: int,
             do_split = can_split & (rank < remaining)
             remaining = remaining - jnp.sum(do_split.astype(jnp.int32))
 
-            slots = level_start + jnp.arange(width)
+            slots = level_start + jnp.arange(width, dtype=jnp.int32)
             split_feature = split_feature.at[slots].set(
                 jnp.where(do_split, best_feat, -1))
             threshold_bin = threshold_bin.at[slots].set(
                 jnp.where(do_split, best_bin, 0))
 
             # ---- child stats: winner shard supplies, psum broadcasts ---
-            sel = jnp.arange(width)
+            sel = jnp.arange(width, dtype=jnp.int32)
             loc_best_feat_idx = (loc_fb // b).astype(jnp.int32)
             cum_best = cum[sel, loc_best_feat_idx]        # (width, B, 3)
             left_loc = jnp.take_along_axis(

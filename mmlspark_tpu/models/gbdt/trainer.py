@@ -1011,7 +1011,7 @@ def _level_histogram_quant(binned, grad_q, hess_q, live, local, width,
 
     def chunk_body(acc, xs):
         cb, cl, cg, ch, cn = xs
-        base = (cl[:, None] * f + jnp.arange(f)[None, :]) * b
+        base = (cl[:, None] * f + jnp.arange(f, dtype=jnp.int32)[None, :]) * b
         idx = (base + cb.astype(jnp.int32)).reshape(-1)
         data = jnp.stack([
             jnp.broadcast_to(cg[:, None], (chunk, f)).reshape(-1),
@@ -1158,7 +1158,7 @@ def _level_histogram(binned, grad, hess, live, local, width, f, b,
     n = binned.shape[0]
     # flat index = (local * F + f) * B + bin, shared by the two
     # remaining formulations
-    base = (local[:, None] * f + jnp.arange(f)[None, :]) * b
+    base = (local[:, None] * f + jnp.arange(f, dtype=jnp.int32)[None, :]) * b
     idx = (base + binned).reshape(-1)
 
     # Three separate scalar segment_sums sharing the index vector: the
@@ -1208,7 +1208,7 @@ def _derive_sibling_hist(hist_small, prev_hist, prev_split, prev_ss):
     import jax.numpy as jnp
 
     width = hist_small.shape[0]
-    kids = jnp.arange(width)
+    kids = jnp.arange(width, dtype=jnp.int32)
     par_idx = kids // 2
     is_small = (kids % 2) == prev_ss[par_idx]
     sib = hist_small[kids ^ 1]
@@ -1257,7 +1257,7 @@ def _find_numeric_splits(hist, feat_mask, remaining, parent_value, *, b,
     node_fmask = feat_mask[None, :] > 0
     ok &= node_fmask[:, :, None]
     # last bin can't split (right side empty by construction)
-    ok &= jnp.arange(b)[None, None, :] < b - 1
+    ok &= jnp.arange(b, dtype=jnp.int32)[None, None, :] < b - 1
     gain = jnp.where(ok, gain, -jnp.inf)
 
     flat_gain = gain.reshape(width, -1)
@@ -1274,8 +1274,8 @@ def _find_numeric_splits(hist, feat_mask, remaining, parent_value, *, b,
     do_split = can_split & (rank < remaining)
     remaining = remaining - jnp.sum(do_split.astype(jnp.int32))
 
-    left_mask = jnp.arange(b)[None, :] <= best_bin[:, None]
-    hist_best = hist[jnp.arange(width), best_feat]      # (width, B, 3)
+    left_mask = jnp.arange(b, dtype=jnp.int32)[None, :] <= best_bin[:, None]
+    hist_best = hist[jnp.arange(width, dtype=jnp.int32), best_feat]      # (width, B, 3)
     left_stats = jnp.sum(hist_best * left_mask[..., None], axis=1)
     tot_best = jnp.sum(hist_best, axis=1)
     right_stats = tot_best - left_stats
@@ -1575,7 +1575,7 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
                 node_value = node_value.at[0].set(rv0)
                 node_count = node_count.at[0].set(tot0[2])
 
-            slots = level_start + jnp.arange(width)
+            slots = level_start + jnp.arange(width, dtype=jnp.int32)
             if simple_numeric:
                 (do_split, best_feat, best_bin, left_mask, lval, rval,
                  left_stats, right_stats, remaining, small_side) = \
@@ -1650,7 +1650,7 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
                 node_fmask = node_fmask & (draw >= kth)
             ok &= node_fmask[:, :, None]
             # last bin can't split (right side empty by construction)
-            ok &= jnp.arange(b)[None, None, :] < b - 1
+            ok &= jnp.arange(b, dtype=jnp.int32)[None, None, :] < b - 1
             if has_mono:
                 # reject splits whose child values violate the feature's
                 # monotone direction (LightGBM "basic" rejection)
@@ -1659,13 +1659,13 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
                 # one random candidate threshold per (node, feature)
                 kd = jax.random.fold_in(key, d)
                 rand_bin = jax.random.randint(kd, (width, f), 0, b - 1)
-                ok &= jnp.arange(b)[None, None, :] == rand_bin[..., None]
+                ok &= jnp.arange(b, dtype=jnp.int32)[None, None, :] == rand_bin[..., None]
             gain = jnp.where(ok, gain, -jnp.inf)
 
             if has_cat:
                 # --- categorical split finding ----------------------
                 g_b, h_b, c_b = hist[..., 0], hist[..., 1], hist[..., 2]
-                not_missing = jnp.arange(b)[None, None, :] > 0
+                not_missing = jnp.arange(b, dtype=jnp.int32)[None, None, :] > 0
                 used = (c_b > 0) & not_missing
                 # LightGBM min_data_per_group: the sorted scan only
                 # considers categories with enough rows (filtered ones
@@ -1687,7 +1687,7 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
                 _, cscore_r = leaf_objective(gr_c, hr_c, cfg.cat_l2)
                 _, cscore_p = leaf_objective(gt, ht, cfg.cat_l2)
                 cgain = 0.5 * (cscore_l + cscore_r - cscore_p)
-                pos1 = jnp.arange(1, b + 1)[None, None, :]  # left-set size
+                pos1 = jnp.arange(1, b + 1, dtype=jnp.int32)[None, None, :]  # left-set size
                 side = jnp.minimum(pos1, num_sorted[..., None] - pos1)
                 cok = ((pos1 < num_sorted[..., None])
                        & (side <= cfg.max_cat_threshold)
@@ -1727,8 +1727,8 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
                 remaining - jnp.sum(do_split.astype(jnp.int32)))
 
             # --- per-node left-bin mask for the chosen split -------------
-            sel = jnp.arange(width)
-            mask_num = jnp.arange(b)[None, :] <= best_bin[:, None]
+            sel = jnp.arange(width, dtype=jnp.int32)
+            mask_num = jnp.arange(b, dtype=jnp.int32)[None, :] <= best_bin[:, None]
             if has_cat:
                 chosen_cat = is_cat_f[best_feat] & do_split
                 s_idx = sort_idx[sel, best_feat]        # (width, B)
@@ -1737,7 +1737,7 @@ def make_build_tree(num_features: int, total_bins: int, cfg: TrainConfig,
                 used_sel = used_sorted[sel, best_feat]
                 onehot_sel = num_used[sel, best_feat] <= cfg.max_cat_to_onehot
                 mask_prefix = (bin_rank <= best_bin[:, None]) & used_sel
-                mask_onehot = jnp.arange(b)[None, :] == best_bin[:, None]
+                mask_onehot = jnp.arange(b, dtype=jnp.int32)[None, :] == best_bin[:, None]
                 mask_cat = jnp.where(onehot_sel[:, None], mask_onehot,
                                      mask_prefix)
                 left_mask = jnp.where(chosen_cat[:, None], mask_cat, mask_num)
@@ -1926,7 +1926,8 @@ def _with_bin_mask(fn, total_bins):
 
     def wrapped(*args):
         sf, tb, nv, cnt = fn(*args)
-        bgl = (jnp.arange(total_bins)[None, :] <= tb[:, None]) & (sf >= 0)[:, None]
+        bins = jnp.arange(total_bins, dtype=jnp.int32)
+        bgl = (bins[None, :] <= tb[:, None]) & (sf >= 0)[:, None]
         return sf, tb, nv, cnt, jnp.zeros(sf.shape[0], jnp.int8), bgl
 
     return wrapped
@@ -2875,8 +2876,11 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
     carry = (raw, tuple(vs["raw"] for vs in valid_states))
 
     # entry guard: a NaN entering here would otherwise surface 100
-    # iterations later as a mysteriously constant model
+    # iterations later as a mysteriously constant model; the dtype
+    # contract pins the input widths so a config-flipped default
+    # cannot silently retrain at a different precision
     sanitizer.check_finite("gbdt.train_scan.entry", data)
+    sanitizer.check_dtype_contract("gbdt.train_scan.entry", data)
 
     # metric record layout must match the step body's stacking order
     labels_order = []
@@ -3014,6 +3018,7 @@ def _train_scan(cfg, k, num_f, total_bins, binned_d, labels_d, weights_d,
     _check_callback_failure()
     # jit-boundary exit guard: raw scores after the last fused step
     sanitizer.check_finite("gbdt.train_scan.exit", carry)
+    sanitizer.check_dtype_contract("gbdt.train_scan.exit", carry)
     with measures.phase("validation"):
         sync_metrics_through(stop_after)
         # single batched transfer of all kept trees

@@ -235,12 +235,16 @@ def level_histogram(binned: np.ndarray, grad: np.ndarray,
         # injection point on the histogram RESULT: arming corrupt here
         # proves a bad data-plane answer changes the model (so parity
         # tests really exercise this kernel); delay simulates a slow one
-        return sanitizer.check_finite(
-            "gbdt.level_hist", fault_point("gbdt.level_hist", out))
+        return sanitizer.check_dtype_contract(
+            "gbdt.level_hist", sanitizer.check_finite(
+                "gbdt.level_hist",
+                fault_point("gbdt.level_hist", out)))
     out = np.zeros((width, f, n_bins, 3), np.float32)
     if n == 0:
-        return sanitizer.check_finite(
-            "gbdt.level_hist", fault_point("gbdt.level_hist", out))
+        return sanitizer.check_dtype_contract(
+            "gbdt.level_hist", sanitizer.check_finite(
+                "gbdt.level_hist",
+                fault_point("gbdt.level_hist", out)))
     idx_base = local.astype(np.int64) * n_bins
     chans = (grad * live, hess * live, live)
     for j in range(f):
@@ -249,8 +253,10 @@ def level_histogram(binned: np.ndarray, grad: np.ndarray,
             out[:, j, :, c] = np.bincount(
                 idx, weights=w, minlength=width * n_bins
             ).reshape(width, n_bins).astype(np.float32)
-    return sanitizer.check_finite(
-        "gbdt.level_hist", fault_point("gbdt.level_hist", out))
+    return sanitizer.check_dtype_contract(
+        "gbdt.level_hist", sanitizer.check_finite(
+            "gbdt.level_hist",
+            fault_point("gbdt.level_hist", out)))
 
 
 def quant_histogram_available() -> bool:
@@ -296,12 +302,16 @@ def level_histogram_quant(binned: np.ndarray, grad_q: np.ndarray,
            local.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
            width, n_bins, gscale_inv, hscale_inv,
            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
-        return sanitizer.check_finite(
-            "gbdt.level_hist", fault_point("gbdt.level_hist", out))
+        return sanitizer.check_dtype_contract(
+            "gbdt.level_hist", sanitizer.check_finite(
+                "gbdt.level_hist",
+                fault_point("gbdt.level_hist", out)))
     out = np.zeros((width, f, n_bins, 3), np.float32)
     if n == 0:
-        return sanitizer.check_finite(
-            "gbdt.level_hist", fault_point("gbdt.level_hist", out))
+        return sanitizer.check_dtype_contract(
+            "gbdt.level_hist", sanitizer.check_finite(
+                "gbdt.level_hist",
+                fault_point("gbdt.level_hist", out)))
     gate = live != 0
     idx_base = local.astype(np.int64) * n_bins
     # float64 bincount of integer-valued weights is exact below 2^53,
@@ -318,8 +328,10 @@ def level_histogram_quant(binned: np.ndarray, grad_q: np.ndarray,
                                minlength=width * n_bins)
             out[:, j, :, c] = (sums.reshape(width, n_bins)
                                * s).astype(np.float32)
-    return sanitizer.check_finite(
-        "gbdt.level_hist", fault_point("gbdt.level_hist", out))
+    return sanitizer.check_dtype_contract(
+        "gbdt.level_hist", sanitizer.check_finite(
+            "gbdt.level_hist",
+            fault_point("gbdt.level_hist", out)))
 
 
 def load_csv(path: str, skip_header: bool = True

@@ -219,25 +219,38 @@ def resolve_infer_autocast() -> str:
     return mode
 
 
+def placement_cast(x, dtype):
+    """THE sanctioned low-precision placement seam: cast float ``x``
+    to ``dtype`` (None or a non-float ``x`` passes through unchanged).
+
+    Every low-precision cast in the tree must route through here —
+    graftlint GL015 flags any other ``astype(bfloat16)`` in the repo —
+    so bf16 placement stays behind :func:`resolve_infer_autocast`'s
+    warn-once policy and the graftsan dtype contract sees one seam."""
+    import jax.numpy as jnp
+
+    v = jnp.asarray(x)
+    if dtype is not None and jnp.issubdtype(v.dtype, jnp.floating):
+        return v.astype(dtype)
+    return v
+
+
 def make_shard_and_gather_fns(partition_specs, mesh=None,
                               dtype_specs=None):
     """Per-leaf (shard_fns, gather_fns) pytrees.
 
     ``shard_fns`` place a host leaf on-device under its rule-derived
     NamedSharding (or as a plain committed array when ``mesh`` is
-    None), optionally casting float leaves to ``dtype_specs`` (a
-    single dtype — the bf16 autocast path; None leaves dtypes alone).
-    ``gather_fns`` fetch back to host numpy.
+    None), optionally casting float leaves to ``dtype_specs`` via
+    :func:`placement_cast` (a single dtype — the bf16 autocast path;
+    None leaves dtypes alone). ``gather_fns`` fetch back to host
+    numpy.
     """
     import jax
-    import jax.numpy as jnp
 
     def make_shard(spec):
         def shard(x):
-            v = jnp.asarray(x)
-            if dtype_specs is not None and jnp.issubdtype(
-                    v.dtype, jnp.floating):
-                v = v.astype(dtype_specs)
+            v = placement_cast(x, dtype_specs)
             if mesh is not None:
                 sharding = jax.sharding.NamedSharding(
                     mesh, spec_to_pspec(spec))

@@ -353,3 +353,114 @@ class TestSanLock:
     def test_san_lock_rejects_unknown_kind(self):
         with pytest.raises(ValueError, match="unknown kind"):
             san.san_lock("x", kind="semaphore")
+
+
+# --- dtype contracts (graftdtype runtime twin) ------------------------------
+
+@pytest.mark.dtype_smoke
+class TestDtypeContract:
+    def test_drift_drill_aborts_attributed_on_the_crossing(self):
+        """THE acceptance drill: flip one leaf's width mid-run and the
+        very next crossing raises, naming the boundary and the leaf —
+        not a later step, not an unattributed numerics divergence."""
+        san.enable()
+        payload = {"scores": np.zeros(4, np.float32),
+                   "bins": np.zeros(4, np.uint8)}
+        san.check_dtype_contract("gbdt.train_scan.exit", payload)
+        payload["scores"] = payload["scores"].astype(np.float16)
+        with pytest.raises(san.DtypeDrift) as ei:
+            san.check_dtype_contract("gbdt.train_scan.exit", payload)
+        msg = str(ei.value)
+        assert "'gbdt.train_scan.exit'" in msg
+        assert "value['scores']" in msg
+        assert "float32" in msg and "float16" in msg
+        assert ei.value.boundary == "gbdt.train_scan.exit"
+        assert ei.value.leaf == "value['scores']"
+        assert ei.value.before == "float32"
+        assert ei.value.after == "float16"
+
+    def test_disabled_arm_passes_drifted_values_through(self):
+        """SAN off: the same drill completes, values untouched (the
+        identity return is the bitwise contract)."""
+        a = {"w": np.zeros(3, np.float32)}
+        b = {"w": np.zeros(3, np.float16)}
+        assert san.check_dtype_contract("b", a) is a
+        assert san.check_dtype_contract("b", b) is b
+        assert san.dtype_contracts() == {}
+
+    def test_matching_crossings_record_once_and_pass(self):
+        san.enable()
+        x = {"w": np.ones(2, np.float32)}
+        assert san.check_dtype_contract("b", x) is x
+        assert san.check_dtype_contract("b", x) is x
+        assert san.dtype_contracts() == {
+            "b": {"value['w']": "float32"}}
+
+    def test_arity_tolerance_compares_common_leaves_only(self):
+        """Optional payloads (a probe batch without labels, a carry
+        that grows a slot) must not false-positive: only leaves present
+        in both signatures are compared, and new leaves join the
+        recorded contract."""
+        san.enable()
+        san.check_dtype_contract("probe", {"a": np.zeros(1, np.float32)})
+        san.check_dtype_contract(
+            "probe", {"a": np.zeros(1, np.float32),
+                      "lbl": np.zeros(1, np.int8)})
+        san.check_dtype_contract("probe", {"a": np.zeros(1, np.float32)})
+        # ... but the joined leaf is now held to its width
+        with pytest.raises(san.DtypeDrift):
+            san.check_dtype_contract(
+                "probe", {"lbl": np.zeros(1, np.int32)})
+
+    def test_scalars_and_extension_leaves_carry_no_contract(self):
+        san.enable()
+        san.check_dtype_contract(
+            "b", {"n": 3, "f": 0.5, "s": "x", "none": None,
+                  "obj": object()})
+        assert san.dtype_contracts() == {"b": {}}
+
+    def test_reset_clears_contracts(self):
+        san.enable()
+        san.check_dtype_contract("b", np.zeros(1, np.float32))
+        san.reset()
+        assert san.dtype_contracts() == {}
+        # fresh contract: the other width is legal again
+        san.check_dtype_contract("b", np.zeros(1, np.float16))
+
+    def test_env_gate_turns_only_the_dtype_check_off(self):
+        from mmlspark_tpu.core.env import SAN_DTYPE
+        with env_override(SAN, "1"), env_override(SAN_DTYPE, "0"):
+            san.refresh_from_env()
+            assert san.enabled()
+            san.check_dtype_contract("b", np.zeros(1, np.float32))
+            san.check_dtype_contract("b", np.zeros(1, np.float16))
+            assert san.dtype_contracts() == {}
+            # the rest of the sanitizer is still live
+            with pytest.raises(san.NonFiniteError):
+                san.check_finite("b", np.array([np.nan]))
+        san.refresh_from_env()
+
+    def test_disabled_call_overhead_within_budget(self):
+        """Acceptance bound: the disabled check_dtype_contract call
+        costs <=200ns over a no-op passthrough (one module-global
+        boolean). Best-of-trials delta to shed CI scheduler noise."""
+        payload = {"p": 1.0}
+
+        def passthrough(boundary, value):
+            return value
+
+        reps = 200_000
+
+        def probe(fn):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                fn("bench", payload)
+            return (time.perf_counter() - t0) / reps * 1e9
+
+        probe(passthrough), probe(san.check_dtype_contract)   # warm
+        deltas = []
+        for _ in range(3):
+            deltas.append(probe(san.check_dtype_contract)
+                          - probe(passthrough))
+        best = min(deltas)
+        assert best <= 200.0, f"disabled dtype contract adds {best:.0f}ns"

@@ -1,0 +1,38 @@
+"""GL013 fixture: weak-type hazards in traced bodies (NEVER imported)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+
+
+@jax.jit
+def f64_constant(x):
+    # np.float64 built under the trace: silently truncated to f32
+    scale = np.float64(1.5)
+    return x * scale
+
+
+@jax.jit
+def precise_literal(x):
+    # 16 significant digits cannot survive the f32 truncation
+    return x * 2.718281828459045
+
+
+@jax.jit
+def default_ctors(x):
+    # both constructors inherit the ambient default-dtype config
+    acc = jnp.zeros(x.shape[0])
+    idx = jnp.arange(8)
+    return acc + idx
+
+
+def shard_body(x):
+    # shard_map bodies are traced too
+    pad = jnp.full((4,), 0.0)
+    return x + pad
+
+
+def build(mesh, spec):
+    return shard_map(shard_body, mesh=mesh, in_specs=spec,
+                     out_specs=spec)

@@ -1,0 +1,38 @@
+"""GL013 clean fixture: all patterns here are legal (NEVER imported).
+
+Short literals (0.5, 1e-6, 0.1) are below the precision radar even
+though some fail an exact float32 round-trip; dtype-pinned
+constructors (keyword, positional dtype, or ``x.dtype``) pass; host
+helpers and callback bodies may use float64 freely.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def common_literals(x):
+    y = x * 0.5 + 1e-6
+    return y * 0.1
+
+
+@jax.jit
+def pinned_ctors(x):
+    acc = jnp.zeros(x.shape[0], dtype=jnp.float32)
+    idx = jnp.arange(8, dtype=jnp.int32)
+    pad = jnp.full((4,), 0.0, x.dtype)
+    return acc + idx + pad
+
+
+def host_helper(x):
+    # host code: float64 precision is the point here
+    return np.float64(x).sum() * 2.718281828459045
+
+
+@jax.jit
+def with_callback(x):
+    # callback bodies are host code by design
+    return jax.pure_callback(
+        lambda v: np.float64(v * 2.718281828459045).astype(np.float32),
+        jax.ShapeDtypeStruct(x.shape, x.dtype), x)

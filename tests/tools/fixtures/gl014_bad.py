@@ -1,0 +1,30 @@
+"""GL014 fixture: parity-boundary narrowing (NEVER imported)."""
+
+import jax.numpy as jnp
+from mmlspark_tpu.io.checkpoint import read_checkpoint
+from mmlspark_tpu.models.gbdt.trainer import _pow2_scale
+from mmlspark_tpu.native import bindings
+
+
+def narrowed_scale(g):
+    # pow2-exact quant scale: bf16/f16 cannot represent the contract
+    scale = _pow2_scale(g)
+    return (g * scale).astype(jnp.float16)
+
+
+def viewed_native(h, b):
+    # native-callback result reinterpreted at half width
+    hist = bindings.histogram_f32(h, b)
+    return hist.view(jnp.int16)
+
+
+def narrowed_plane(x, edges):
+    # the uint8 binned plane is itself the pin; int8 breaks it
+    plane = jnp.searchsorted(edges, x).astype(jnp.uint8)
+    return plane.astype(jnp.int8)
+
+
+def narrowed_payload(path):
+    # checkpoint payloads resume bitwise — or not at all
+    payload = read_checkpoint(path)
+    return payload.astype(jnp.float16)

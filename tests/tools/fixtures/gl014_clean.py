@@ -1,0 +1,36 @@
+"""GL014 clean fixture: all patterns here are legal (NEVER imported).
+
+Widening a pinned value, deriving a low-precision copy from the raw
+source data, and selecting small integer constants by a pinned-derived
+mask (the decision-bits idiom) are all blessed.
+"""
+
+import jax.numpy as jnp
+from mmlspark_tpu.models.gbdt.trainer import _pow2_scale
+from mmlspark_tpu.native import bindings
+
+
+def widened_scale(g):
+    # float32 is the contract width: never a narrowing
+    scale = _pow2_scale(g)
+    return (g * scale).astype(jnp.float32)
+
+
+def widened_plane(x, edges):
+    plane = jnp.searchsorted(edges, x).astype(jnp.uint8)
+    return plane.astype(jnp.int32)
+
+
+def lowp_from_source(x, b):
+    # the f16 copy derives from the raw rows, not the pinned result
+    hist = bindings.histogram_f32(x, b)
+    small = x.astype(jnp.float16)
+    return hist, small
+
+
+def decision_bits(hist_token, num_bits):
+    # selection moves the branch values, not the predicate's bits:
+    # an int8 decision-bits enum keyed on a pinned-derived mask is
+    # not a narrowed quant value
+    plane = hist_token.astype(jnp.uint8)
+    return jnp.where(plane, num_bits, 0).astype(jnp.int8)

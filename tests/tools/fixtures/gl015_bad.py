@@ -1,0 +1,24 @@
+"""GL015 fixture: unsafe low-precision accumulation (NEVER imported)."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bf16_matmul_drill(w, x):
+    # THE drill: the cast is an ad-hoc autocast outside the seam, and
+    # the contraction accumulates at bf16 precision
+    wl = w.astype(jnp.bfloat16)
+    return jnp.matmul(wl, x)
+
+
+@jax.jit
+def f16_reduction(g):
+    gl = g.astype(jnp.float16)
+    return gl.sum()
+
+
+@jax.jit
+def matmult_operator(a, b):
+    al = a.astype(jnp.bfloat16)
+    return al @ b

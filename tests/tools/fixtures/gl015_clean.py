@@ -1,0 +1,23 @@
+"""GL015 clean fixture: all patterns here are legal (NEVER imported).
+
+``preferred_element_type`` pins the accumulator, an explicit f32
+upcast kills the taint (it IS the fix), and bf16 placement through
+``shard_rules.placement_cast`` is the one sanctioned autocast seam.
+"""
+
+import jax
+import jax.numpy as jnp
+from mmlspark_tpu.parallel.shard_rules import placement_cast
+
+
+@jax.jit
+def pinned_accumulation(w, x):
+    wl = w.astype(jnp.float16)
+    acc = jnp.matmul(wl, x, preferred_element_type=jnp.float32)
+    wf = wl.astype(jnp.float32)
+    return acc + jnp.sum(wf)
+
+
+def placement(weights):
+    # the dtype_specs placement cast: policy-gated, contract-checked
+    return placement_cast(weights, jnp.bfloat16)

@@ -1,0 +1,29 @@
+"""GL016 fixture: host/device width drift (NEVER imported)."""
+
+import jax
+import numpy as np
+from mmlspark_tpu.native import bindings
+
+step = jax.jit(lambda v: v * 2.0)
+
+
+def split_gain_f64(h):
+    # float64 host contract: exact integer-weight bincounts
+    return np.float64(h).sum()
+
+
+def feeds_jit(h):
+    # the jit boundary decides the width silently
+    gain = split_gain_f64(h)
+    return step(gain)
+
+
+def feeds_native(h, b):
+    # the native kernel requires exact dtypes; f64 mis-reads
+    gain = split_gain_f64(h)
+    return bindings.histogram_f32(gain, b)
+
+
+def callback_operands(fn, shape, x):
+    # np.arange defaults to int64; the device side speaks int32
+    return jax.pure_callback(fn, shape, np.arange(x))

@@ -1,0 +1,32 @@
+"""GL016 clean fixture: all patterns here are legal (NEVER imported).
+
+An explicit cast at the boundary states the width decision; f64
+results consumed host-side never cross; dtype-pinned callback
+operands match the kernel signature.
+"""
+
+import jax
+import numpy as np
+from mmlspark_tpu.native import bindings
+
+step = jax.jit(lambda v: v * 2.0)
+
+
+def split_gain_f64(h):
+    return np.float64(h).sum()
+
+
+def width_decided(h):
+    # the author, not the boundary, decides: accept the narrowing
+    gain = split_gain_f64(h).astype(np.float32)
+    return step(gain)
+
+
+def host_side_only(h):
+    gain = split_gain_f64(h)
+    return float(gain)
+
+
+def pinned_callback(fn, shape, x):
+    return jax.pure_callback(fn, shape,
+                             np.arange(x, dtype=np.int32))

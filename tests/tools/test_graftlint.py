@@ -172,8 +172,9 @@ def test_repo_is_clean_and_fast():
     """The acceptance gate: zero findings over mmlspark_tpu, no
     baseline suppressions involved, fast enough to block every CI run.
 
-    Budget note: 12 rules now run (the graftlock quartet GL009-GL012
-    landed on top of the original eight) and CI boxes can be
+    Budget note: 16 rules now run (the graftdtype quartet GL013-GL016
+    joined the graftlock quartet on top of the original eight) and CI
+    boxes can be
     single-core, where the dataflow-heavy GL006/GL007 passes alone
     take ~12s wall; the bound is a runaway-regression tripwire, not a
     perf benchmark."""
@@ -507,3 +508,86 @@ def test_gl012_catches_blocking_calls_under_lock():
 def test_gl012_clean_fixture_passes():
     # hoisted I/O, timed join/get, get(False), str.join under lock
     assert lint([FIXTURES / "gl012_clean.py"], select=["GL012"]) == []
+
+
+# --- GL013 weak types in traced bodies -----------------------------------
+
+def test_gl013_catches_weak_type_hazards():
+    found = lint([FIXTURES / "gl013_bad.py"], select=["GL013"])
+    msgs = messages(found)
+    assert len(found) == 5, msgs
+    assert any("np.float64 constant" in m for m in msgs), msgs
+    assert any("2.718281828459045" in m and "truncated" in m
+               for m in msgs), msgs
+    assert any("jnp.zeros without an explicit dtype" in m
+               for m in msgs), msgs
+    assert any("jnp.arange without an explicit dtype" in m
+               for m in msgs), msgs
+    # shard_map bodies count as traced too
+    assert any("jnp.full without an explicit dtype" in m
+               for m in msgs), msgs
+    assert all(f.rule == "GL013" for f in found)
+
+
+def test_gl013_clean_fixture_passes():
+    # short literals, pinned ctors, host helpers, callback bodies
+    assert lint([FIXTURES / "gl013_clean.py"], select=["GL013"]) == []
+
+
+# --- GL014 parity-boundary narrowing -------------------------------------
+
+def test_gl014_catches_parity_narrowing():
+    found = lint([FIXTURES / "gl014_bad.py"], select=["GL014"])
+    msgs = messages(found)
+    assert len(found) == 4, msgs
+    # quant scale, native result, binned plane, checkpoint payload
+    assert any(".astype(float16)" in m and "g * scale" in m
+               for m in msgs), msgs
+    assert any(".view(int16)" in m for m in msgs), msgs
+    assert any(".astype(int8)" in m for m in msgs), msgs
+    assert any("payload" in m for m in msgs), msgs
+    assert all(f.rule == "GL014" for f in found)
+    assert all("contract width" in f.hint for f in found)
+
+
+def test_gl014_clean_fixture_passes():
+    # widening casts, f16 from source data, decision-bits selection
+    assert lint([FIXTURES / "gl014_clean.py"], select=["GL014"]) == []
+
+
+# --- GL015 low-precision accumulation ------------------------------------
+
+def test_gl015_catches_lowprec_accumulation():
+    found = lint([FIXTURES / "gl015_bad.py"], select=["GL015"])
+    msgs = messages(found)
+    assert len(found) == 5, msgs
+    # the bf16-accumulation drill: seam finding + accumulation finding
+    assert any("matmul accumulates" in m for m in msgs), msgs
+    assert sum("outside the shard_rules placement-cast seam" in m
+               for m in msgs) == 2, msgs
+    assert any("sum accumulates" in m for m in msgs), msgs
+    assert any("'@' contraction" in m for m in msgs), msgs
+    assert all(f.rule == "GL015" for f in found)
+
+
+def test_gl015_clean_fixture_passes():
+    # preferred_element_type, f32 upcast, placement_cast seam
+    assert lint([FIXTURES / "gl015_clean.py"], select=["GL015"]) == []
+
+
+# --- GL016 host/device width drift ---------------------------------------
+
+def test_gl016_catches_host_width_drift():
+    found = lint([FIXTURES / "gl016_bad.py"], select=["GL016"])
+    msgs = messages(found)
+    assert len(found) == 3, msgs
+    assert any("jitted callable 'step'" in m for m in msgs), msgs
+    assert any("native.bindings kernel" in m for m in msgs), msgs
+    assert any("np.arange without an explicit dtype in host-callback"
+               in m for m in msgs), msgs
+    assert all(f.rule == "GL016" for f in found)
+
+
+def test_gl016_clean_fixture_passes():
+    # explicit boundary cast, host-side consumption, pinned operands
+    assert lint([FIXTURES / "gl016_clean.py"], select=["GL016"]) == []

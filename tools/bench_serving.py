@@ -96,6 +96,60 @@ def _san_lock_disabled_overhead_ns():
     return round(probe(wrapped) - probe(raw), 1)
 
 
+def _san_dtype_disabled_overhead_ns():
+    """Measured per-call cost of a DISABLED check_dtype_contract over a
+    no-op passthrough — the dtype contract guards the serving score
+    path, so this delta rides every scored batch. Same 200k-rep
+    protocol as the san_lock probe; None when the sanitizer is live."""
+    from mmlspark_tpu.core import sanitizer
+
+    if sanitizer.enabled():
+        return None
+
+    def passthrough(boundary, value):
+        return value
+
+    reps = 200_000
+    payload = {"p": 1.0}
+
+    def probe(fn):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn("bench.dtype_probe", payload)
+        return (time.perf_counter() - t0) / reps * 1e9
+
+    probe(passthrough), probe(sanitizer.check_dtype_contract)  # warm
+    return round(probe(sanitizer.check_dtype_contract)
+                 - probe(passthrough), 1)
+
+
+def _score_max_abs_delta_vs_f32(model, rows):
+    """Max abs difference between the active autocast arm's margins
+    and the f32 reference on a fixed probe batch; None when autocast
+    is off (the arms would be the same compiled scorer). Expected
+    bound for bf16: leaf values round at 2^-8 relative step and sum
+    over the trees, so ~num_trees * 2^-8 * mean(|leaf|) — well under
+    1e-2 at bench shape."""
+    import numpy as np
+
+    from mmlspark_tpu.core.env import INFER_AUTOCAST, env_override
+    from mmlspark_tpu.parallel.shard_rules import resolve_infer_autocast
+
+    if resolve_infer_autocast() == "off":
+        return None
+    try:
+        plan = model.serving_binned_plan()
+        with env_override(INFER_AUTOCAST, "off"):
+            ref = model.serving_binned_plan()
+        probe = np.asarray(rows[:64])
+        binned = plan.bin_rows(probe)
+        got = np.asarray(plan.score(binned), dtype=np.float64)
+        want = np.asarray(ref.score(binned), dtype=np.float64)
+    except Exception:
+        return None   # generic-arm model without a binned plane
+    return float(np.max(np.abs(got - want)))
+
+
 def _percentiles(lat):
     lat = sorted(lat)
     if not lat:
@@ -116,6 +170,7 @@ def run_sustained(model, rows, clients=64, duration_s=10.0, binned="auto",
 
     from mmlspark_tpu.core.env import SERVE_BINNED, env_override
     from mmlspark_tpu.io.serving import ServingServer
+    from mmlspark_tpu.parallel.shard_rules import resolve_infer_autocast
 
     with env_override(SERVE_BINNED, binned):
         server = ServingServer(
@@ -207,17 +262,25 @@ def run_sustained(model, rows, clients=64, duration_s=10.0, binned="auto",
         "clients": clients, "duration_s": round(wall, 2),
         "qps": round(ok / wall, 1), "p50_ms": p50, "p99_ms": p99,
         "rejected_503": r503, "timeout_504": t504, "client_errors": errs,
+        "autocast": resolve_infer_autocast(),
+        "score_max_abs_delta_vs_f32": _score_max_abs_delta_vs_f32(
+            model, rows),
         "san_lock_disabled_overhead_ns": _san_lock_disabled_overhead_ns(),
+        "san_dtype_disabled_overhead_ns":
+            _san_dtype_disabled_overhead_ns(),
         "model": MODEL_DESC,
     }
 
 
 def emit_sustained(clients=64, duration_s=10.0, model_rows=None):
-    """Run both arms (generic comparator first, then the binned data
-    plane), print one JSON row per arm + a ratio summary row; returns
-    the summary. Shared by ``--sustained`` here and bench.py's
-    ``--serving-sustained``."""
+    """Run three arms (generic comparator, the binned data plane, then
+    the binned plane under MMLSPARK_TPU_INFER_AUTOCAST=bf16), print one
+    JSON row per arm + ratio summary rows (binned-vs-generic and
+    bf16-vs-f32); returns the binned-vs-generic summary. Shared by
+    ``--sustained`` here and bench.py's ``--serving-sustained``."""
     import jax
+
+    from mmlspark_tpu.core.env import INFER_AUTOCAST, env_override
 
     model, rows = model_rows if model_rows is not None else build_model()
     backend = jax.default_backend()
@@ -225,7 +288,11 @@ def emit_sustained(clients=64, duration_s=10.0, model_rows=None):
                             duration_s=duration_s, binned="off")
     binned = run_sustained(model, rows, clients=clients,
                            duration_s=duration_s, binned="on")
-    for row in (generic, binned):
+    with env_override(INFER_AUTOCAST, "bf16"):
+        bf16 = run_sustained(model, rows, clients=clients,
+                             duration_s=duration_s, binned="on")
+    bf16["arm"] = f"{bf16['arm']}_bf16"
+    for row in (generic, binned, bf16):
         row["backend"] = backend
         print(json.dumps(row), flush=True)
     summary = {
@@ -237,6 +304,17 @@ def emit_sustained(clients=64, duration_s=10.0, model_rows=None):
         "clients": clients, "model": MODEL_DESC, "backend": backend,
     }
     print(json.dumps(summary), flush=True)
+    bf16_summary = {
+        "metric": "serving_bf16_speedup",
+        "value": (round(bf16["qps"] / binned["qps"], 2)
+                  if binned["qps"] else None),
+        "unit": "x_vs_f32_binned",
+        "qps_bf16": bf16["qps"], "qps_f32": binned["qps"],
+        "score_max_abs_delta_vs_f32":
+            bf16["score_max_abs_delta_vs_f32"],
+        "clients": clients, "model": MODEL_DESC, "backend": backend,
+    }
+    print(json.dumps(bf16_summary), flush=True)
     return summary
 
 
@@ -366,6 +444,8 @@ def run_elastic(model, rows, clients=16, duration_s=12.0,
         "rejected": rejected,
         "scale_p99_ms": scale_p99_ms,
         "san_lock_disabled_overhead_ns": _san_lock_disabled_overhead_ns(),
+        "san_dtype_disabled_overhead_ns":
+            _san_dtype_disabled_overhead_ns(),
         "model": MODEL_DESC,
     }
 
@@ -493,6 +573,8 @@ def run_gray(model, rows, clients=8, duration_s=8.0, hedging=True,
         "reply_mismatches": sum(r[4] for r in results if r),
         "replies_bitwise": sum(r[4] for r in results if r) == 0,
         "san_lock_disabled_overhead_ns": _san_lock_disabled_overhead_ns(),
+        "san_dtype_disabled_overhead_ns":
+            _san_dtype_disabled_overhead_ns(),
         "model": MODEL_DESC,
     }
 

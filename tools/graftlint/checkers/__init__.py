@@ -30,9 +30,19 @@ def all_checkers() -> List[object]:
         ConditionDisciplineChecker)
     from tools.graftlint.checkers.gl012_blocking_under_lock import (
         BlockingUnderLockChecker)
+    from tools.graftlint.checkers.gl013_weak_types import (
+        WeakTypeChecker)
+    from tools.graftlint.checkers.gl014_parity_narrowing import (
+        ParityNarrowingChecker)
+    from tools.graftlint.checkers.gl015_lowprec_accumulation import (
+        LowPrecAccumulationChecker)
+    from tools.graftlint.checkers.gl016_host_width_drift import (
+        HostWidthDriftChecker)
     return [CollectiveAxisChecker(), TracerHygieneChecker(),
             RecompilationChecker(), RegistryDriftChecker(),
             DeterminismChecker(), CollectiveDivergenceChecker(),
             AccumulatorWidthChecker(), CrossFunctionChecker(),
             LockOrderChecker(), UnguardedStateChecker(),
-            ConditionDisciplineChecker(), BlockingUnderLockChecker()]
+            ConditionDisciplineChecker(), BlockingUnderLockChecker(),
+            WeakTypeChecker(), ParityNarrowingChecker(),
+            LowPrecAccumulationChecker(), HostWidthDriftChecker()]

@@ -35,7 +35,10 @@ def build_parser() -> argparse.ArgumentParser:
                     "divergence, GL007 accumulator width, GL008 "
                     "cross-function context, GL009 lock-order "
                     "inversion, GL010 unguarded shared state, GL011 "
-                    "condition discipline, GL012 blocking-under-lock)")
+                    "condition discipline, GL012 blocking-under-lock, "
+                    "GL013 weak types in traced bodies, GL014 "
+                    "parity-boundary narrowing, GL015 low-precision "
+                    "accumulation, GL016 host/device width drift)")
     p.add_argument("paths", nargs="*", default=["mmlspark_tpu"],
                    help="files or directories to scan "
                         "(default: mmlspark_tpu)")
